@@ -1,0 +1,106 @@
+"""Communicators: XLA collectives over a named mesh axis.
+
+TPU-native replacements for the reference's three communicators
+(grace_dl/dist/communicator/{allreduce,allgather,broadcast}.py), which issue
+eager c10d/Horovod NCCL calls per tensor. Here each communicator is a pure
+function of the payload built from `jax.lax` collectives, traced inside
+`shard_map`/`pjit` over a device mesh so XLA schedules them on ICI and
+overlaps them with compute — no handle tables, no background thread
+(cf. patch_files/horovod/torch/mpi_ops.py:68-75,423-439).
+
+Compatibility matrix (reference IMPLEMENTING.md:43-45): ``Allreduce`` only
+suits compressors whose payload is dense, same-shaped and summable (none,
+fp16, randomk, powersgd); ``Allgather`` is general-purpose; ``Broadcast``
+exists for parity and is realised with the same all-gather collective — a
+loop of per-root broadcasts (grace_dl/dist/communicator/broadcast.py:18-33)
+would serialise W collectives for an identical result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import Communicator, Compressor, Ctx, Payload
+
+__all__ = ["Allreduce", "Allgather", "Broadcast", "Identity"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Allreduce(Communicator):
+    """Sum payloads across ranks, then decompress once.
+
+    Mirrors grace_dl/dist/communicator/allreduce.py:6-13: all-reduce each
+    payload tensor, divide by world size if ``compressor.average``, then
+    decompress the summed payload. Valid only for linear codecs.
+    """
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        summed = tuple(lax.psum(t, self.axis_name) for t in payload)
+        if compressor.average and payload:
+            if not all(jnp.issubdtype(t.dtype, jnp.inexact) for t in summed):
+                raise TypeError(
+                    "Allreduce with average=True requires float payloads; "
+                    f"got {[t.dtype for t in summed]}. Use Allgather for "
+                    "integer-coded compressors (see IMPLEMENTING.md:43-45 "
+                    "compatibility matrix in the reference).")
+            w = self.world_size()
+            summed = tuple(t / w for t in summed)
+        return compressor.decompress(summed, ctx)
+
+
+@dataclasses.dataclass(frozen=True)
+class Allgather(Communicator):
+    """Gather every rank's payload, decompress per rank, aggregate.
+
+    Mirrors grace_dl/dist/communicator/allgather.py:7-45. The reference's
+    variable-size path (gather sizes → pad → split, lines 16-38) is
+    unnecessary: payloads are statically shaped under XLA, with invalid lanes
+    zero-valued (see compressors with static-capacity payloads). Per-rank
+    decompression is vmapped over the gathered world axis and runs as one
+    fused XLA computation instead of the reference's Python loop
+    (SURVEY.md §3.1 hot spot).
+    """
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        if not payload:
+            # e.g. PowerSGD: communication already happened inside compress.
+            return compressor.decompress(payload, ctx)
+        gathered = tuple(
+            lax.all_gather(t, self.axis_name, axis=0, tiled=False)
+            for t in payload)
+        stacked = jax.vmap(lambda p: compressor.decompress(p, ctx))(gathered)
+        out = compressor.aggregate(stacked)
+        if compressor.average:
+            out = out / self.world_size()
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Broadcast(Allgather):
+    """Parity alias for the reference's broadcast communicator.
+
+    The reference loops over root ranks broadcasting each payload and
+    decompressing it (grace_dl/dist/communicator/broadcast.py:18-33) — W
+    sequential collectives computing exactly what one all-gather computes.
+    On TPU we keep the all-gather realisation; semantics (per-rank decompress
+    → aggregate → optional average) are identical.
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class Identity(Communicator):
+    """No-op communicator: decompress this rank's own payload.
+
+    No reference analog; used for single-device debugging and as the
+    injectable no-comm fake the reference never wrote (SURVEY.md §4).
+    """
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        return compressor.decompress(payload, ctx)

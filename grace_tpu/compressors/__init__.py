@@ -1,0 +1,34 @@
+"""Compression algorithm catalog.
+
+One module per algorithm, mirroring the reference's
+grace_dl/{dist,torch,tensorflow}/compressor/ trees — except that the three
+per-backend copies (SURVEY.md §1 "parallel siblings") collapse into this one
+functional implementation.
+"""
+
+from grace_tpu.compressors.none import NoneCompressor
+from grace_tpu.compressors.fp16 import FP16Compressor
+from grace_tpu.compressors.topk import TopKCompressor
+from grace_tpu.compressors.randomk import RandomKCompressor
+from grace_tpu.compressors.threshold import ThresholdCompressor
+from grace_tpu.compressors.qsgd import QSGDCompressor
+from grace_tpu.compressors.terngrad import TernGradCompressor
+from grace_tpu.compressors.signsgd import SignSGDCompressor, SignumCompressor
+from grace_tpu.compressors.efsignsgd import EFSignSGDCompressor
+from grace_tpu.compressors.onebit import OneBitCompressor
+from grace_tpu.compressors.natural import NaturalCompressor
+from grace_tpu.compressors.dgc import DgcCompressor
+from grace_tpu.compressors.powersgd import PowerSGDCompressor
+from grace_tpu.compressors.sketch import SketchCompressor
+from grace_tpu.compressors.u8bit import U8bitCompressor
+from grace_tpu.compressors.adaq import AdaqCompressor
+from grace_tpu.compressors.inceptionn import InceptionNCompressor
+
+__all__ = [
+    "NoneCompressor", "FP16Compressor", "TopKCompressor", "RandomKCompressor",
+    "ThresholdCompressor", "QSGDCompressor", "TernGradCompressor",
+    "SignSGDCompressor", "SignumCompressor", "EFSignSGDCompressor",
+    "OneBitCompressor", "NaturalCompressor", "DgcCompressor",
+    "PowerSGDCompressor", "SketchCompressor", "U8bitCompressor",
+    "AdaqCompressor", "InceptionNCompressor",
+]

@@ -1,0 +1,42 @@
+"""EF-SignSGD (error-feedback sign SGD, Karimireddy et al. 2019).
+
+Reference: grace_dl/dist/compressor/efsignsgd.py:6-33 + memory at
+grace_dl/dist/memory/efsignsgd.py:4-19. Payload is the mean |x| scale plus
+the sign bits (bit-packed here); aggregation sums the scaled signs and
+divides by the learning rate, undoing the lr-scaling the paired memory
+applied during compensate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+from grace_tpu.ops.packing import pack_bits, unpack_bits
+
+
+@dataclasses.dataclass(frozen=True)
+class EFSignSGDCompressor(Compressor):
+    average = False
+
+    lr: float = 0.1
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape, numel = x.shape, x.size
+        flat = x.reshape(-1)
+        mean = jnp.mean(jnp.abs(flat))
+        packed = pack_bits(flat >= 0)
+        return (mean, packed), (numel, shape, x.dtype), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        mean, packed = payload
+        numel, shape, dtype = ctx
+        signs = unpack_bits(packed, numel).astype(dtype) * 2 - 1
+        return (mean * signs).reshape(shape)
+
+    def aggregate(self, stacked: jax.Array) -> jax.Array:
+        return jnp.sum(stacked, axis=0) / self.lr

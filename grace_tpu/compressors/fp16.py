@@ -1,0 +1,29 @@
+"""Half-precision downcast compressor.
+
+Reference: grace_dl/dist/compressor/fp16.py:6-22 (cast to fp16, cast back;
+ctx records the original dtype). TPU-first addition: ``dtype='bfloat16'`` is
+the default — bf16 is the TPU's native half format (MXU input type, no
+overflow cliff at 65504) — with ``'float16'`` available for bit-parity with
+the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+
+
+@dataclasses.dataclass(frozen=True)
+class FP16Compressor(Compressor):
+    dtype: str = "bfloat16"
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        return (x.astype(self.dtype),), x.dtype, state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        (x,) = payload
+        return x.astype(ctx)

@@ -1,0 +1,105 @@
+"""INCEPTIONN-style error-bounded floating-point compression (MICRO 2018).
+
+Reference: grace_dl/tensorflow/compressor/inceptionn.py:8-188 — route each
+value by exponent into a 32/16/8-bit lane, encode sign + marker-prefixed
+truncated mantissa, pack the 2-bit lane mask 4/byte, and emit three
+variable-length value streams. That wire format is irreducibly
+data-dependent, which XLA's static-shape model cannot express
+(SURVEY.md §7 hard part 1), so this is a **redesign with the same
+error-bounded semantics and a static wire format**:
+
+* every in-range value is encoded as a 16-bit marker code: sign bit,
+  then the mantissa truncated by ``n_shift = 127 − exp`` with a marker bit
+  prepended so the decoder recovers the exponent from the code's own
+  magnitude (the reference's find-the-marker-bit trick, inceptionn.py:
+  124-148, realized as floor(log2(code)));
+* values with exponent below the error bound produce code 0 (dropped);
+  the bound is clamped to 2^-14 — deeper truncation cannot keep the
+  marker inside 16 bits (the reference's 8-bit lane silently zeroes such
+  codes; here the bound is explicit);
+* values ≥ 1.0 (exp ≥ 127, unencodable by right-shift) go exact into a
+  fixed-capacity fp32 overflow lane chosen by magnitude top-k; overflow
+  beyond capacity clamps to the largest 16-bit-lane value (~1.0).
+
+Wire cost: 2 bytes/value + overflow lane ≈ ≥2× compression, vs the
+reference's 1–4 bytes/value adaptive stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+
+_MANT_BITS = 23
+_MARKER = jnp.uint32(1 << 22)
+
+
+def _floor_log2(x: jax.Array) -> jax.Array:
+    """floor(log2(x)) for uint32 x in [1, 2^24), exact via float32 exponent."""
+    f = x.astype(jnp.float32)
+    return ((lax.bitcast_convert_type(f, jnp.uint32) >> _MANT_BITS)
+            .astype(jnp.int32) - 127)
+
+
+@dataclasses.dataclass(frozen=True)
+class InceptionNCompressor(Compressor):
+    tensors_size_are_same = False
+
+    error_bound: float = 1e-4
+    overflow_ratio: float = 0.0625
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape, numel = x.shape, x.size
+        flat = x.reshape(-1).astype(jnp.float32)
+        bits = lax.bitcast_convert_type(flat, jnp.uint32)
+        sign = bits >> 31
+        exp = ((bits >> _MANT_BITS) & 0xFF).astype(jnp.int32)
+        mantissa = bits & jnp.uint32((1 << _MANT_BITS) - 1)
+
+        # drop everything below the error bound; 16-bit marker codes cannot
+        # truncate deeper than n_shift = 14.
+        eb_exp = max(113, 127 + int(math.floor(math.log2(self.error_bound))))
+
+        # 16-bit lane (exponent in [eb_exp, 127)): reference encode scheme
+        # (inceptionn.py:41-53) — marker-prefixed mantissa shifted by
+        # n_shift, sign in the MSB.
+        n_shift = jnp.clip(127 - exp, 1, 14).astype(jnp.uint32)
+        body = ((mantissa >> 1) | _MARKER) >> n_shift          # bits <= 21
+        code = ((sign << 15) | (body >> 7)).astype(jnp.uint16)
+        in_band = (exp >= eb_exp) & (exp < 127)
+        v16 = jnp.where(in_band, code, 0).astype(jnp.uint16)
+        # overflow values (exp >= 127) clamp to just-under-1.0 in the 16-bit
+        # lane unless the fp32 lane picks them up (decompress overwrites).
+        max_code = jnp.uint32(0x7FFF)  # n_shift=1 marker + all-ones mantissa
+        v16 = jnp.where(exp >= 127,
+                        ((sign << 15) | max_code).astype(jnp.uint16), v16)
+
+        cap = max(1, int(numel * self.overflow_ratio))
+        mags, idx = lax.top_k(jnp.abs(flat), min(cap, numel))
+        idx = idx.astype(jnp.int32)
+        v32 = jnp.where(mags >= 1.0, flat[idx], 0.0)
+        return (v16, v32, idx), (numel, shape, x.dtype), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        v16, v32, idx = payload
+        numel, shape, dtype = ctx
+        code = v16.astype(jnp.uint32)
+        sign = code >> 15
+        body = code & jnp.uint32(0x7FFF)
+        p = _floor_log2(jnp.maximum(body, 1))        # marker position = 15 - n_shift
+        mant = (body ^ (jnp.uint32(1) << p.astype(jnp.uint32))) \
+            << (_MANT_BITS - p).astype(jnp.uint32)
+        exp = (112 + p).astype(jnp.uint32)           # 127 - n_shift
+        fbits = (sign << 31) | (exp << _MANT_BITS) | mant
+        vals = lax.bitcast_convert_type(fbits, jnp.float32)
+        vals = jnp.where(body == 0, 0.0, vals)
+        # fp32 overflow lane overwrites its coordinates exactly.
+        out = vals.at[idx].set(jnp.where(v32 != 0, v32, vals[idx]))
+        return out.reshape(shape).astype(dtype)

@@ -1,0 +1,56 @@
+"""Natural compression (Horváth et al. 2019): stochastic power-of-two rounding.
+
+Reference: grace_dl/dist/compressor/natural.py:9-40 — the only GPU-kernel
+code in the reference (CuPy via DLPack). The codec: bitcast fp32 to int,
+stochastically round the exponent up with probability mantissa/2^23, clip
+the biased exponent to [18, 145], and pack sign+shifted-exponent into one
+uint8 (code 0 ⇒ underflow to zero). On TPU this is pure
+``lax.bitcast_convert_type`` + jnp bitwise ops — XLA fuses it, no custom
+kernel needed (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+
+_MANTISSA_BITS = 23
+_MANTISSA_MASK = (1 << _MANTISSA_BITS) - 1
+_EXP_MASK = 0xFF << _MANTISSA_BITS
+_MIN_BIASED_EXP = 18   # reference clip: 0b00001001000... = 18 << 23
+_MAX_BIASED_EXP = 145  # reference clip: 0b01001000100... = 145 << 23
+
+
+@dataclasses.dataclass(frozen=True)
+class NaturalCompressor(Compressor):
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape = x.shape
+        flat = x.reshape(-1).astype(jnp.float32)
+        bits = lax.bitcast_convert_type(flat, jnp.uint32)
+        sign = (bits >> 31).astype(jnp.uint8)
+        exp = (bits & _EXP_MASK) >> _MANTISSA_BITS           # biased exponent
+        mantissa = bits & _MANTISSA_MASK
+        rnd = jax.random.randint(rng, flat.shape, 0, _MANTISSA_MASK,
+                                 dtype=jnp.int32).astype(jnp.uint32)
+        exp = jnp.where(mantissa > rnd, exp + 1, exp)
+        exp = jnp.clip(exp, _MIN_BIASED_EXP, _MAX_BIASED_EXP)
+        # 7-bit exponent code in [0, 127]; 0 flushes to zero on decompress.
+        code = (sign << 7) | (exp - _MIN_BIASED_EXP).astype(jnp.uint8)
+        return (code.astype(jnp.uint8),), (shape, x.dtype), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        (code,) = payload
+        shape, dtype = ctx
+        sign = code >= 128
+        exp_code = (code & 0x7F).astype(jnp.uint32)
+        bits = (exp_code + _MIN_BIASED_EXP) << _MANTISSA_BITS
+        mag = lax.bitcast_convert_type(bits.astype(jnp.uint32), jnp.float32)
+        out = jnp.where(sign, -mag, mag)
+        out = jnp.where(exp_code >= 1, out, 0.0)
+        return out.reshape(shape).astype(dtype)

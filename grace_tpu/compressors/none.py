@@ -1,0 +1,30 @@
+"""Identity (no-op) compressor. Reference: grace_dl/dist/compressor/none.py:4-12."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+
+
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class NoneCompressor(Compressor):
+    """Pass-through: payload is the tensor itself.
+
+    ``average`` is configurable like the reference ctor flag
+    (grace_dl/dist/__init__.py:18), but keyword-only: the reference example
+    misuse ``NoneCompressor(0.005)`` (examples/torch/pytorch_mnist.py:122)
+    silently set ``average=0.005``; here it is a TypeError.
+    """
+
+    average: bool = True
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        return (x,), None, state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        (x,) = payload
+        return x

@@ -1,0 +1,45 @@
+"""QSGD stochastic quantization (Alistarh et al. 2017).
+
+Reference: grace_dl/dist/compressor/qsgd.py:6-38 — quantize |x| to
+``quantum_num`` levels scaled by the L2 norm, stochastic rounding, sign
+folded into the signed level. Payload dtype: int8 when quantum_num < 128;
+for larger level counts the reference casts to torch.half (qsgd.py:27),
+which silently loses integer precision above 2048 — here we use int16
+instead (exact, same wire width). The torch copy's leftover debug prints
+(torch/compressor/qsgd.py:14-15,33-34) are, of course, not replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+
+
+@dataclasses.dataclass(frozen=True)
+class QSGDCompressor(Compressor):
+    quantum_num: int = 64
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape = x.shape
+        flat = x.reshape(-1)
+        norm = jnp.linalg.norm(flat)
+        abs_g = jnp.abs(flat)
+        level_float = jnp.where(norm > 0, self.quantum_num / norm * abs_g, 0.0)
+        previous_level = jnp.floor(level_float)
+        prob = jax.random.uniform(rng, flat.shape)
+        is_next = (prob < (level_float - previous_level)).astype(flat.dtype)
+        new_level = previous_level + is_next
+        signed = new_level * jnp.sign(flat)
+        dtype = jnp.int8 if self.quantum_num < 128 else jnp.int16
+        return (signed.astype(dtype), norm), (shape, x.dtype), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        levels, norm = payload
+        shape, dtype = ctx
+        out = norm / self.quantum_num * levels.astype(dtype)
+        return out.reshape(shape)

@@ -1,0 +1,45 @@
+"""Random-K sparsification with rank-shared index selection.
+
+Reference: grace_dl/dist/compressor/randomk.py:6-40 — every rank seeds the
+global torch RNG with ``hash(name) + global_step`` so all ranks draw the same
+random index set; only values travel, indices live in ctx. The JAX design
+makes the shared-randomness contract explicit instead of a global-seed hack
+(SURVEY.md §7 hard part 5): the pipeline hands ``compress`` an rng key that
+is ``fold_in(fold_in(seed, step), leaf_index)`` — replicated across ranks by
+construction — so the permutation is identical everywhere and the indices
+legitimately belong in ctx.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+from grace_tpu.ops.sparse import scatter_dense
+from grace_tpu.compressors.topk import static_k
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomKCompressor(Compressor):
+    compress_ratio: float = 0.3
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape, numel = x.shape, x.size
+        flat = x.reshape(-1)
+        k = static_k(numel, self.compress_ratio)
+        # Sampling WITHOUT replacement, like the dist/torch reference
+        # (randperm, randomk.py:26-29). The TF variant samples with
+        # replacement and has a maxval off-by-one (SURVEY.md §2.3) — a bug,
+        # not replicated.
+        indices = jax.random.permutation(rng, numel)[:k].astype(jnp.int32)
+        values = flat[indices]
+        return (values,), (indices, numel, shape), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        (values,) = payload
+        indices, numel, shape = ctx
+        return scatter_dense(values, indices, numel, shape)

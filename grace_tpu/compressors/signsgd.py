@@ -1,0 +1,74 @@
+"""SignSGD with majority-vote aggregation.
+
+Reference: grace_dl/dist/compressor/signsgd.py:6-30 — transmit ``x >= 0`` as
+one uint8 per element; aggregate = sum of ±1 then re-sign (majority vote);
+``average=False``. TPU-first change: signs are bit-packed 8/byte
+(grace_tpu.ops.packing), an 8× wire reduction the reference leaves on the
+table. Note for the allreduce-style path: ``psum`` of ±1 followed by sign is
+an exact majority vote (SURVEY.md §7 hard part 4) — exposed via
+``aggregate`` on the gathered stack, which XLA lowers to the same reduction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+from grace_tpu.ops.packing import pack_bits, unpack_bits
+
+
+def _signs_to_float(bits: jax.Array, dtype) -> jax.Array:
+    return bits.astype(dtype) * 2 - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SignSGDCompressor(Compressor):
+    average = False
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape, numel = x.shape, x.size
+        flat = x.reshape(-1)
+        packed = pack_bits(flat >= 0)
+        return (packed,), (numel, shape, x.dtype), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        (packed,) = payload
+        numel, shape, dtype = ctx
+        signs = _signs_to_float(unpack_bits(packed, numel), dtype)
+        return signs.reshape(shape)
+
+    def aggregate(self, stacked: jax.Array) -> jax.Array:
+        # Majority vote: reference signsgd.py:25-30.
+        summed = jnp.sum(stacked, axis=0)
+        return (summed >= 0).astype(stacked.dtype) * 2 - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SignumCompressor(SignSGDCompressor):
+    """SignSGD on a momentum-filtered gradient.
+
+    Reference: grace_dl/dist/compressor/signum.py:6-37 — the compressor holds
+    per-name momentum dicts; here momentum is explicit per-leaf state
+    ``(m, initialized)`` so it jits and checkpoints. First step transmits the
+    raw gradient's sign (reference: ``if name in self.momentums`` miss path).
+    """
+
+    momentum: float = 0.9
+
+    def init_state(self, x: jax.Array) -> State:
+        return {"momentum": jnp.zeros(x.size, x.dtype),
+                "initialized": jnp.zeros((), jnp.bool_)}
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape, numel = x.shape, x.size
+        flat = x.reshape(-1)
+        blended = (1.0 - self.momentum) * flat + self.momentum * state["momentum"]
+        m = jnp.where(state["initialized"], blended, flat)
+        packed = pack_bits(m >= 0)
+        new_state = {"momentum": m, "initialized": jnp.ones((), jnp.bool_)}
+        return (packed,), (numel, shape, x.dtype), new_state

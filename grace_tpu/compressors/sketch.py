@@ -1,0 +1,43 @@
+"""SketchML quantile-sketch compression (Jiang et al., SIGMOD 2018).
+
+Reference: grace_dl/tensorflow/compressor/sketch.py:6-39 — quantile edges
+over the tensor, per-element bin ids, per-bin means; decompress gathers the
+bin means. TF's `tfp.stats.quantiles`/`find_bins`/`unsorted_segment_mean`
+become `jnp.quantile`/`searchsorted`/`segment_sum` (bin count is static, so
+segment reduction compiles cleanly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchCompressor(Compressor):
+    bins: int = 64
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape = x.shape
+        flat = x.reshape(-1)
+        qs = jnp.linspace(0.0, 1.0, self.bins + 1)
+        edges = jnp.quantile(flat, qs)
+        # interior edges -> bin ids in [0, bins)
+        ids = jnp.clip(jnp.searchsorted(edges[1:-1], flat, side="right"),
+                       0, self.bins - 1)
+        sums = jax.ops.segment_sum(flat, ids, num_segments=self.bins)
+        counts = jax.ops.segment_sum(jnp.ones_like(flat), ids,
+                                     num_segments=self.bins)
+        means = sums / jnp.maximum(counts, 1.0)
+        id_dtype = jnp.uint8 if self.bins <= 256 else jnp.uint16
+        return (ids.astype(id_dtype), means), (shape, x.dtype), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        ids, means = payload
+        shape, dtype = ctx
+        return means[ids.astype(jnp.int32)].reshape(shape).astype(dtype)

@@ -1,0 +1,46 @@
+"""Hard-threshold sparsification.
+
+Reference: grace_dl/dist/compressor/threshold.py:6-27 — transmit every entry
+with |x| > τ as (values, indices); payload size is data-dependent
+(``tensors_size_are_same=False``). XLA requires static shapes, so this build
+uses a **fixed-capacity payload** (SURVEY.md §7 hard part 1): capacity
+``⌈capacity_ratio·n⌉`` lanes hold the largest-magnitude entries; lanes whose
+value does not exceed τ carry value 0, making scatter decompression
+value-exact without a count field. If more than `capacity` entries exceed τ
+the smallest ones are dropped (a documented deviation that only ever drops
+the least significant entries).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+from grace_tpu.ops.sparse import scatter_dense
+
+
+@dataclasses.dataclass(frozen=True)
+class ThresholdCompressor(Compressor):
+    tensors_size_are_same = False
+
+    threshold: float = 0.01
+    capacity_ratio: float = 0.25
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape, numel = x.shape, x.size
+        flat = x.reshape(-1)
+        cap = max(1, int(numel * self.capacity_ratio))
+        mags, indices = lax.top_k(jnp.abs(flat), cap)
+        indices = indices.astype(jnp.int32)
+        values = jnp.where(mags > self.threshold, flat[indices], 0)
+        return (values, indices), (numel, shape), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        values, indices = payload
+        numel, shape = ctx
+        return scatter_dense(values, indices, numel, shape)

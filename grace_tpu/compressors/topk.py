@@ -1,0 +1,43 @@
+"""Top-K magnitude sparsification.
+
+Reference: grace_dl/dist/compressor/topk.py:6-36 — keep the k = ⌈ratio·n⌉
+largest-magnitude entries, ship (values, indices), scatter into zeros to
+decompress. ``jax.lax.top_k`` maps directly onto this with a static k, so
+the payload shape is fixed at trace time (XLA requirement) and identical on
+every rank — the all-gather path needs no size exchange.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+from grace_tpu.ops.sparse import scatter_dense
+
+
+def static_k(numel: int, ratio: float) -> int:
+    return max(1, int(numel * ratio))
+
+
+@dataclasses.dataclass(frozen=True)
+class TopKCompressor(Compressor):
+    compress_ratio: float = 0.3
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape, numel = x.shape, x.size
+        flat = x.reshape(-1)
+        k = static_k(numel, self.compress_ratio)
+        _, indices = lax.top_k(jnp.abs(flat), k)
+        indices = indices.astype(jnp.int32)
+        values = flat[indices]
+        return (values, indices), (numel, shape), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        values, indices = payload
+        numel, shape = ctx
+        return scatter_dense(values, indices, numel, shape)

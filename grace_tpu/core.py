@@ -1,0 +1,149 @@
+"""Core abstractions of grace-tpu: Compressor, Memory, Communicator.
+
+This is a TPU-native (JAX/XLA) re-design of the GRACE decomposition of
+compressed data-parallel training (reference: grace_dl/dist/__init__.py:4-52).
+The reference models the triad as stateful Python classes holding name-keyed
+dicts of residuals/momenta and issuing eager NCCL/MPI calls per tensor. Here:
+
+* **Compressors and memories are frozen dataclasses of static hyperparameters
+  with pure methods.** All cross-step state (residual buffers, momenta,
+  PowerSGD's Q factor) is an explicit per-leaf state pytree threaded through
+  the step — so the whole pipeline jits into one XLA program, and compression
+  state checkpoints alongside parameters (the reference never checkpoints it;
+  see SURVEY.md §5).
+* **Communication is expressed with `jax.lax` collectives over a named mesh
+  axis** (`psum` / `all_gather`), executed inside `jax.shard_map` / `pjit`.
+  XLA's async scheduling over ICI replaces Horovod's background thread and
+  handle/synchronize machinery (reference patch_files/horovod/torch/mpi_ops.py).
+* **Payload vs ctx contract** (replaces the reference's loose `(tensors, ctx)`
+  pair): `payload` is a tuple of arrays that travel on the wire and may differ
+  per rank; `ctx` is decode context that MUST be identical on every rank
+  (static Python values, or arrays derived from replicated inputs such as the
+  shared RNG key). This is what lets the all-gather path `vmap` decompression
+  over the gathered world axis.
+
+Wire-format note: XLA requires static shapes, so the reference's variable-size
+payloads (threshold/dgc/adaq, `tensors_size_are_same=False`) become
+fixed-capacity payloads whose invalid lanes carry zero values — scatter-add
+decompression is then value-exact without a length field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# A tuple of arrays that travels on the wire (may differ across ranks).
+Payload = Tuple[jax.Array, ...]
+# Decode context, identical across ranks (static python data or replicated arrays).
+Ctx = Any
+# Per-leaf cross-step compressor/memory state (arbitrary pytree, often None).
+State = Any
+
+DEFAULT_AXIS = "data"
+
+
+@dataclasses.dataclass(frozen=True)
+class Compressor:
+    """Lossy gradient codec (reference ABC: grace_dl/dist/__init__.py:15-35).
+
+    Class attributes (mirroring the reference's instance flags,
+    grace_dl/dist/__init__.py:18-20):
+
+    * ``average`` — divide the aggregate by world size (mean semantics).
+      Sign-based methods set False (grace_dl/dist/compressor/signsgd.py:9).
+    * ``tensors_size_are_same`` — retained for API parity/documentation. Under
+      XLA every payload is statically shaped, so the all-gather communicator
+      never needs the reference's size-exchange dance
+      (grace_dl/dist/communicator/allgather.py:16-38).
+    """
+
+    average = True
+    tensors_size_are_same = True
+
+    # -- cross-step state ---------------------------------------------------
+    def init_state(self, x: jax.Array) -> State:
+        """Initial per-leaf state (e.g. Signum momentum, PowerSGD Q)."""
+        return None
+
+    # -- codec --------------------------------------------------------------
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        """Encode ``x``; return (wire payload, decode ctx, next state)."""
+        raise NotImplementedError
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        """Decode one rank's payload back to a dense tensor."""
+        raise NotImplementedError
+
+    def aggregate(self, stacked: jax.Array) -> jax.Array:
+        """Reduce decompressed tensors stacked along a leading world axis.
+
+        Default: sum (reference grace_dl/dist/__init__.py:32-34). SignSGD
+        overrides with a majority vote.
+        """
+        return jnp.sum(stacked, axis=0)
+
+
+@dataclasses.dataclass(frozen=True)
+class Memory:
+    """Error-feedback memory (reference ABC: grace_dl/dist/__init__.py:4-13).
+
+    The reference mutates name-keyed dicts; here ``compensate``/``update``
+    thread an explicit per-leaf state pytree. ``compensate`` may also update
+    state (DGC's momentum/accumulation buffers mutate during compensate —
+    grace_dl/dist/memory/dgc.py:16-30 — hence the two-stage contract).
+    """
+
+    def init_state(self, x: jax.Array) -> State:
+        return None
+
+    def compensate(self, x: jax.Array, state: State
+                   ) -> tuple[jax.Array, State]:
+        """Fold residual state into the incoming gradient."""
+        return x, state
+
+    def update(self, compensated: jax.Array, payload: Payload, ctx: Ctx,
+               compressor: Compressor, state: State) -> State:
+        """Store the new residual = compensated - decompress(payload)."""
+        return state
+
+
+@dataclasses.dataclass(frozen=True)
+class Communicator:
+    """Collective exchange of compressed payloads over a named mesh axis.
+
+    Reference ABC: grace_dl/dist/__init__.py:37-52. ``exchange`` must be
+    called inside a `shard_map`/`pjit` context where ``axis_name`` is bound.
+    The reference's async handle machinery (grace_dl/torch/__init__.py:37-58)
+    has no analog: XLA schedules and overlaps collectives itself.
+    """
+
+    axis_name: str = DEFAULT_AXIS
+
+    def world_size(self) -> jax.Array:
+        return lax.psum(1, self.axis_name)
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        """Exchange payloads across ranks; return the aggregated dense tensor."""
+        raise NotImplementedError
+
+    # -- the universal 6-stage pipeline ------------------------------------
+    def step(self, x: jax.Array, mem_state: State, comp_state: State,
+             memory: Memory, compressor: Compressor, rng: jax.Array
+             ) -> tuple[jax.Array, State, State]:
+        """compensate → compress → update-residual → exchange.
+
+        Mirrors grace_dl/dist/__init__.py:47-52 but returns next states
+        functionally instead of mutating dicts.
+        """
+        compensated, mem_state = memory.compensate(x, mem_state)
+        payload, ctx, comp_state = compressor.compress(compensated, comp_state, rng)
+        mem_state = memory.update(compensated, payload, ctx, compressor, mem_state)
+        out = self.exchange(payload, ctx, compressor)
+        return out, mem_state, comp_state

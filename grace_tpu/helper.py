@@ -1,0 +1,125 @@
+"""String-keyed factory: the `grace_from_params` compatibility surface.
+
+Reference: grace_dl/dist/helper.py:1-86 (and the torch/tf twins). The params
+dict schema is preserved so reference users can port configs verbatim:
+``compressor`` / ``memory`` / ``communicator`` selectors plus per-algorithm
+hyperparameters (``compress_ratio``, ``quantum_num``, ``threshold``,
+``momentum``, ``gradient_clipping``, ``compress_rank``, ``lr``). Differences:
+
+* ``world_size`` is accepted and ignored — world size is a property of the
+  device mesh, not configuration.
+* ``axis_name`` selects the mesh axis (default ``'data'``).
+* The reference's latent Broadcast bug (helper.py:84 omits the required
+  ``rank`` ctor arg → TypeError) has no analog: broadcast needs no rank here.
+* Returns a :class:`Grace` bundle with ``.transform(seed)`` (optax) instead
+  of a stateful Communicator object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import optax
+
+from grace_tpu import comm
+from grace_tpu import compressors as C
+from grace_tpu import memories as M
+from grace_tpu.core import DEFAULT_AXIS, Communicator, Compressor, Memory
+from grace_tpu.transform import grace_transform
+
+
+@dataclasses.dataclass(frozen=True)
+class Grace:
+    """Bundle of the configured triad; the `grc` object of reference examples."""
+
+    compressor: Compressor
+    memory: Memory
+    communicator: Communicator
+
+    def transform(self, seed: int = 0) -> optax.GradientTransformation:
+        return grace_transform(self.compressor, self.memory,
+                               self.communicator, seed=seed)
+
+
+def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
+    name = params.get("compressor", "none")
+    ratio = params.get("compress_ratio", 0.3)
+    if name == "none":
+        return C.NoneCompressor()
+    if name in ("fp16", "bf16", "bfloat16"):
+        return C.FP16Compressor(dtype="float16" if name == "fp16" else "bfloat16")
+    if name == "topk":
+        return C.TopKCompressor(compress_ratio=ratio)
+    if name == "randomk":
+        return C.RandomKCompressor(compress_ratio=ratio)
+    if name == "threshold":
+        return C.ThresholdCompressor(threshold=params.get("threshold", 0.01))
+    if name == "qsgd":
+        return C.QSGDCompressor(quantum_num=params.get("quantum_num", 64))
+    if name == "terngrad":
+        return C.TernGradCompressor()
+    if name == "signsgd":
+        return C.SignSGDCompressor()
+    if name == "signum":
+        return C.SignumCompressor(momentum=params.get("momentum", 0.9))
+    if name == "efsignsgd":
+        return C.EFSignSGDCompressor(lr=params.get("lr", 0.1))
+    if name == "onebit":
+        return C.OneBitCompressor()
+    if name == "natural":
+        return C.NaturalCompressor()
+    if name == "dgc":
+        return C.DgcCompressor(compress_ratio=params.get("compress_ratio", 0.01))
+    if name == "powersgd":
+        return C.PowerSGDCompressor(rank=params.get("compress_rank", 1),
+                                    axis_name=axis)
+    if name == "u8bit":
+        return C.U8bitCompressor()
+    if name == "sketch":
+        return C.SketchCompressor(bins=params.get("quantum_num", 256))
+    if name == "adaq":
+        return C.AdaqCompressor(compress_ratio=params.get("compress_ratio", 0.01))
+    if name == "inceptionn":
+        return C.InceptionNCompressor()
+    raise ValueError(f"unknown compressor {name!r}")
+
+
+def _build_memory(params: Dict[str, Any], axis: str) -> Memory:
+    name = params.get("memory", "none")
+    if name == "none":
+        return M.NoneMemory()
+    if name == "residual":
+        return M.ResidualMemory(beta=params.get("beta", 1.0),
+                                gamma=params.get("gamma", 1.0))
+    if name == "efsignsgd":
+        return M.EFSignSGDMemory(lr=params.get("lr", 0.1))
+    if name == "dgc":
+        return M.DgcMemory(momentum=params.get("momentum", 0.9),
+                           gradient_clipping=params.get("gradient_clipping",
+                                                        False),
+                           axis_name=axis)
+    if name == "powersgd":
+        return M.PowerSGDMemory()
+    raise ValueError(f"unknown memory {name!r}")
+
+
+def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
+    name = params.get("communicator", "allgather")
+    if name == "allreduce":
+        return comm.Allreduce(axis_name=axis)
+    if name == "allgather":
+        return comm.Allgather(axis_name=axis)
+    if name == "broadcast":
+        return comm.Broadcast(axis_name=axis)
+    if name in ("identity", "none"):
+        return comm.Identity(axis_name=axis)
+    raise ValueError(f"unknown communicator {name!r}")
+
+
+def grace_from_params(params: Dict[str, Any]) -> Grace:
+    """Configure the triad from the reference's params-dict schema."""
+    axis = params.get("axis_name", DEFAULT_AXIS)
+    return Grace(compressor=_build_compressor(params, axis),
+                 memory=_build_memory(params, axis),
+                 communicator=_build_communicator(params, axis))

@@ -1,0 +1,138 @@
+"""Error-feedback memories as explicit state pytrees.
+
+Reference: grace_dl/dist/memory/*.py — name-keyed dicts of residual buffers
+mutated in place. Here each memory is a frozen dataclass whose per-leaf
+state is returned functionally, so the whole pipeline jits and the state
+checkpoints with orbax alongside the parameters (the reference silently
+resets error feedback on resume; SURVEY.md §5 checkpoint row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import DEFAULT_AXIS, Compressor, Ctx, Memory, Payload, State
+
+__all__ = ["NoneMemory", "ResidualMemory", "EFSignSGDMemory", "DgcMemory",
+           "PowerSGDMemory"]
+
+
+@dataclasses.dataclass(frozen=True)
+class NoneMemory(Memory):
+    """No-op memory (grace_dl/dist/memory/none.py:4-11)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidualMemory(Memory):
+    """Classic error feedback (grace_dl/dist/memory/residual.py:4-20).
+
+    compensate: ``β·residual + γ·grad``; update: ``residual = compensated −
+    decompress(payload)``. First step has zero residual (reference: dict-miss
+    path returns the raw tensor, equivalent since β·0 + γ·g = γ·g... the
+    reference actually skips the γ scaling on the miss; with the default
+    γ=1.0 the behaviors coincide, and for γ≠1 a uniformly-scaled first step
+    is the saner semantics).
+    """
+
+    beta: float = 1.0
+    gamma: float = 1.0
+
+    def init_state(self, x: jax.Array) -> State:
+        return jnp.zeros_like(x)
+
+    def compensate(self, x: jax.Array, state: State):
+        return self.beta * state + self.gamma * x, state
+
+    def update(self, compensated: jax.Array, payload: Payload, ctx: Ctx,
+               compressor: Compressor, state: State) -> State:
+        return compensated - compressor.decompress(payload, ctx)
+
+
+@dataclasses.dataclass(frozen=True)
+class EFSignSGDMemory(Memory):
+    """EF-SignSGD memory (grace_dl/dist/memory/efsignsgd.py:4-19).
+
+    compensate: ``residual + lr·grad`` — the lr scaling is undone by the
+    paired compressor's aggregate (÷lr).
+    """
+
+    lr: float = 0.1
+
+    def init_state(self, x: jax.Array) -> State:
+        return jnp.zeros_like(x)
+
+    def compensate(self, x: jax.Array, state: State):
+        return state + self.lr * x, state
+
+    def update(self, compensated: jax.Array, payload: Payload, ctx: Ctx,
+               compressor: Compressor, state: State) -> State:
+        return compensated - compressor.decompress(payload, ctx)
+
+
+@dataclasses.dataclass(frozen=True)
+class DgcMemory(Memory):
+    """DGC momentum-corrected memory (grace_dl/dist/memory/dgc.py:7-39).
+
+    compensate: optional global-norm gradient clipping (the all-reduce of the
+    squared sum becomes ``lax.psum`` over the mesh axis), then momentum
+    accumulation ``u = m·u + g`` and gradient accumulation ``v = v + u``.
+    update: zero both accumulators at the transmitted coordinates. The
+    transmitted mask is reconstructed from the payload's (values, indices) —
+    the reference smuggles it through ctx (dgc.py:42) which would break the
+    replicated-ctx contract here.
+    """
+
+    momentum: float = 0.9
+    gradient_clipping: bool = False
+    axis_name: str = DEFAULT_AXIS
+
+    def init_state(self, x: jax.Array) -> State:
+        return {"residual": jnp.zeros_like(x), "gradient": jnp.zeros_like(x)}
+
+    def compensate(self, x: jax.Array, state: State):
+        if self.gradient_clipping:
+            sq_sum = lax.psum(jnp.sum(x * x), self.axis_name)
+            w = lax.psum(1, self.axis_name)
+            clip = jnp.sqrt(sq_sum / w)
+            x = jnp.clip(x, -clip, clip)
+        residual = self.momentum * state["residual"] + x
+        gradient = state["gradient"] + residual
+        return gradient, {"residual": residual, "gradient": gradient}
+
+    def update(self, compensated: jax.Array, payload: Payload, ctx: Ctx,
+               compressor: Compressor, state: State) -> State:
+        values, indices = payload
+        numel, shape = ctx
+        sent = jnp.zeros((numel,), jnp.bool_).at[indices].set(values != 0)
+        keep = (~sent).reshape(shape).astype(compensated.dtype)
+        return {"residual": state["residual"] * keep,
+                "gradient": state["gradient"] * keep}
+
+
+@dataclasses.dataclass(frozen=True)
+class PowerSGDMemory(Memory):
+    """PowerSGD error feedback (grace_dl/dist/memory/powersgd.py:6-37).
+
+    Holds only the residual; the Q factor lives in the compressor's own
+    state (see grace_tpu/compressors/powersgd.py for why the reference's
+    shared ``q_memory`` dict coupling is dissolved). 1-D tensors bypass
+    (reference compensate lines 14-15).
+    """
+
+    def init_state(self, x: jax.Array) -> State:
+        return None if x.ndim <= 1 else jnp.zeros_like(x)
+
+    def compensate(self, x: jax.Array, state: State):
+        if state is None:
+            return x, state
+        return x + state, state
+
+    def update(self, compensated: jax.Array, payload: Payload, ctx: Ctx,
+               compressor: Compressor, state: State) -> State:
+        if state is None:
+            return state
+        return compensated - compressor.decompress(payload, ctx)

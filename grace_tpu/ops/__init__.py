@@ -1,0 +1,6 @@
+from grace_tpu.ops.packing import (pack_2bit, pack_bits, unpack_2bit,
+                                   unpack_bits)
+from grace_tpu.ops.sparse import scatter_dense
+
+__all__ = ["pack_bits", "unpack_bits", "pack_2bit", "unpack_2bit",
+           "scatter_dense"]

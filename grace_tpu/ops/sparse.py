@@ -1,0 +1,23 @@
+"""Shared sparse-codec primitive: scatter (values, indices) into a dense tensor.
+
+The reference repeats this scatter in every sparsifying compressor
+(e.g. grace_dl/dist/compressor/topk.py:14-18 `desparsify`); here it is the
+one shared implementation used by topk/randomk/threshold/dgc/adaq.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def scatter_dense(values: jax.Array, indices: jax.Array, numel: int,
+                  shape: tuple) -> jax.Array:
+    """Place ``values`` at flat ``indices`` of a zero tensor of ``shape``.
+
+    Fixed-capacity payloads rely on invalid lanes carrying value 0, which a
+    scatter-set writes harmlessly (every index is in range; duplicates do
+    not occur by construction — top_k/permutation indices are unique).
+    """
+    flat = jnp.zeros((numel,), values.dtype).at[indices].set(values)
+    return flat.reshape(shape)

@@ -1,0 +1,81 @@
+"""Mesh construction and multi-host initialization helpers.
+
+TPU-native replacement for the reference's cluster bring-up: ``hvd.init()``
+(MPI topology, examples/torch/pytorch_mnist.py:50) and
+``dist.init_process_group('nccl', 'tcp://…')``
+(examples/dist/CIFAR10-dawndist/core.py:225-226). On TPU, process discovery
+and ICI/DCN topology come from `jax.distributed.initialize` + the device
+mesh; collectives ride ICI within a slice and DCN across slices with no
+NCCL/MPI anywhere.
+
+The default mesh is 1-D over axis ``'data'`` — GRACE's scope is exactly
+synchronous data parallelism (SURVEY.md §2.5) — but axes are named so model/
+sequence axes can be added later without API change.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from grace_tpu.core import DEFAULT_AXIS
+
+__all__ = ["DEFAULT_AXIS", "data_parallel_mesh", "make_mesh",
+           "initialize_distributed", "replicated", "batch_sharded",
+           "local_world_size"]
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (replaces hvd.init / init_process_group).
+
+    On Cloud TPU all arguments are auto-detected from the metadata server;
+    pass them explicitly for other clusters. Must run before any JAX
+    computation (do NOT touch jax.devices()/process_count() first — that
+    initializes the local backend and forecloses cluster bring-up).
+
+    With no arguments and no detectable cluster environment this is a no-op
+    (single-process run). With explicit arguments, failures propagate: a
+    mis-configured multi-host job must die loudly rather than silently train
+    as independent single-host replicas.
+    """
+    if coordinator_address is None and num_processes is None and process_id is None:
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            # No cluster env auto-detected: single-process run.
+            return
+    else:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None,
+                       axis_name: str = DEFAULT_AXIS) -> Mesh:
+    """1-D mesh over all (global) devices — the GRACE data-parallel world."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def make_mesh(shape: Sequence[int], axis_names: Sequence[str],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """N-D mesh for layouts beyond pure DP (e.g. ('data', 'model'))."""
+    devices = list(devices) if devices is not None else jax.devices()
+    arr = np.asarray(devices).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def local_world_size(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> int:
+    return mesh.shape[axis_name]

@@ -1,0 +1,92 @@
+"""Training-step builders: the shard_map harness around the compressed pipeline.
+
+Replaces the reference's L5 integration layer (SURVEY.md §1): where GRACE
+patches Horovod's DistributedOptimizer to fire per-parameter hooks during
+backward (patch_files/horovod/torch/__init__.py:107-161), grace-tpu builds
+one jitted SPMD train step: per-device gradients are computed inside
+`shard_map` over the ``'data'`` mesh axis and the optax chain (containing
+`grace_transform`) performs the compressed collective exchange. XLA overlaps
+the compression collectives with remaining backward compute — the async
+send/receive split of the torch backend (grace_dl/torch/__init__.py:37-58)
+falls out of the compiler for free.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from grace_tpu.core import DEFAULT_AXIS
+
+__all__ = ["TrainState", "make_train_step", "make_eval_step"]
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+
+
+def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
+                    optimizer: optax.GradientTransformation,
+                    mesh: Mesh,
+                    axis_name: str = DEFAULT_AXIS,
+                    donate: bool = True):
+    """Build ``step(state, batch) -> (state, loss)``.
+
+    ``loss_fn(params, batch)`` must return the mean loss over its *local*
+    batch shard; gradients are therefore local means, and the communicator's
+    ``average`` semantics reproduce the reference's global mean
+    (grace_dl/dist/__init__.py:51-52 `/ world_size`).
+
+    ``batch`` is a pytree whose leaves are sharded on their leading dim over
+    ``axis_name`` (the DistributedSampler analog, SURVEY.md §2.5).
+    """
+
+    def device_step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        loss = lax.pmean(loss, axis_name)
+        return TrainState(params, opt_state), loss
+
+    sharded = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(P(), P(axis_name)),
+        out_specs=(P(), P()),
+        check_vma=False)
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(sharded, donate_argnums=donate_argnums)
+
+
+def make_eval_step(metric_fn: Callable[[Any, Any], Any], mesh: Mesh,
+                   axis_name: str = DEFAULT_AXIS):
+    """Build ``eval_step(params, batch) -> mesh-averaged metrics``.
+
+    The cross-rank metric averaging idiom of the reference
+    (examples/torch/pytorch_mnist.py:163-166 metric_average via allreduce).
+    """
+
+    def device_eval(params, batch):
+        metrics = metric_fn(params, batch)
+        return jax.tree_util.tree_map(
+            lambda m: lax.pmean(m, axis_name), metrics)
+
+    sharded = jax.shard_map(
+        device_eval, mesh=mesh,
+        in_specs=(P(), P(axis_name)),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def init_train_state(params: Any, optimizer: optax.GradientTransformation
+                     ) -> TrainState:
+    return TrainState(params=params, opt_state=optimizer.init(params))

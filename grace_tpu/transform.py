@@ -1,0 +1,82 @@
+"""Optax integration: compressed gradient exchange as a GradientTransformation.
+
+This replaces the reference's entire Horovod patch surface
+(patch_files/horovod/torch/__init__.py:46-201 `_DistributedOptimizer`,
+patch_files/horovod/tensorflow/__init__.py:190-205 grads fn, …): instead of
+monkey-patching a framework optimizer with per-parameter backward hooks, the
+whole 6-stage GRACE pipeline is an `optax.GradientTransformation` that slots
+into any optax chain:
+
+    tx = optax.chain(grace_transform(compressor, memory, communicator),
+                     optax.sgd(0.1))
+
+``update`` must run where the communicator's mesh axis is bound — i.e.
+inside `shard_map`/`pjit` (see grace_tpu.train.make_train_step). Every
+parameter's compensate→compress→update→exchange is traced into ONE XLA
+program — the reference's per-parameter Python loop over world_size × n_params
+decompressions (SURVEY.md §3.1 hot loop) disappears into the compiler.
+
+State layout: ``GraceState(count, rng_key, mem, comp)`` where ``mem``/``comp``
+are tuples aligned with the flattened gradient leaves. The rng key is
+replicated across ranks, so per-(step, leaf) keys derived via ``fold_in`` are
+rank-identical — the explicit contract RandomK/PowerSGD rely on (the
+reference relied on global-seed side effects, grace_dl/dist/compressor/
+randomk.py:26-29).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from grace_tpu.core import Communicator, Compressor, Memory, State
+
+
+class GraceState(NamedTuple):
+    count: jax.Array          # step counter
+    rng_key: jax.Array        # replicated base key, stored as raw key data
+    mem: Tuple[State, ...]    # per-leaf memory state, leaf order of tree_flatten
+    comp: Tuple[State, ...]   # per-leaf compressor state
+
+
+def grace_transform(compressor: Compressor, memory: Memory,
+                    communicator: Communicator, seed: int = 0
+                    ) -> optax.GradientTransformation:
+    """Build the compressed-exchange transformation.
+
+    The returned transform maps *local* (per-device) gradients to globally
+    aggregated ones, exactly like ``Communicator.step`` in the reference
+    (grace_dl/dist/__init__.py:47-52) but over whole pytrees.
+    """
+
+    def init(params) -> GraceState:
+        leaves = jax.tree_util.tree_leaves(params)
+        mem = tuple(memory.init_state(p) for p in leaves)
+        comp = tuple(compressor.init_state(p) for p in leaves)
+        # Raw key data (uint32) instead of a typed key array so the whole
+        # state is plain-array checkpointable with any writer.
+        return GraceState(count=jnp.zeros((), jnp.int32),
+                          rng_key=jax.random.key_data(jax.random.key(seed)),
+                          mem=mem, comp=comp)
+
+    def update(updates, state: GraceState, params=None):
+        del params
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        base_key = jax.random.wrap_key_data(state.rng_key)
+        step_key = jax.random.fold_in(base_key, state.count)
+        outs, new_mem, new_comp = [], [], []
+        for i, (g, ms, cs) in enumerate(zip(leaves, state.mem, state.comp,
+                                            strict=True)):
+            rng = jax.random.fold_in(step_key, i)
+            out, ms, cs = communicator.step(g, ms, cs, memory, compressor, rng)
+            outs.append(out)
+            new_mem.append(ms)
+            new_comp.append(cs)
+        new_state = GraceState(count=state.count + 1, rng_key=state.rng_key,
+                               mem=tuple(new_mem), comp=tuple(new_comp))
+        return jax.tree_util.tree_unflatten(treedef, outs), new_state
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,36 @@
+"""Test harness: 8 simulated CPU devices.
+
+The reference has no test suite at all (SURVEY.md §4) — multi-rank behavior
+was only exercised on real NCCL clusters. JAX lets us run real collective
+semantics single-process: 8 host devices via XLA_FLAGS, a Mesh over them,
+and `shard_map` executes genuine all_gather/psum. Env vars must be set
+before jax initializes, hence this conftest-level setup.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The dev image's sitecustomize imports jax and latches JAX_PLATFORMS to the
+# TPU tunnel before this file runs, so setting env vars is not enough —
+# override via config (legal until the first backend initializes).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from grace_tpu.parallel import data_parallel_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 simulated devices, got {len(devices)}"
+    return data_parallel_mesh(devices)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,119 @@
+"""Communicator semantics on a real 8-device (simulated CPU) mesh.
+
+This is the "fake backend" the reference never had (SURVEY.md §4): genuine
+all_gather/psum collectives, single process.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from grace_tpu import comm
+from grace_tpu import compressors as C
+
+W = 8
+
+
+def run_exchange(mesh, communicator, compressor, per_rank, state=None, seed=0):
+    """per_rank: [W, ...] array, one slice per rank; returns one rank's output."""
+
+    def body(x):
+        x = x[0]  # shard_map gives [1, ...] per device on the data axis
+        st = state if state is not None else compressor.init_state(x)
+        payload, ctx, _ = compressor.compress(x, st, jax.random.key(seed))
+        return communicator.exchange(payload, ctx, compressor)[None]
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                       out_specs=P("data"), check_vma=False)
+    return np.asarray(fn(per_rank)[0])
+
+
+def test_allreduce_none_average(mesh, rng):
+    x = rng.normal(size=(W, 16)).astype(np.float32)
+    out = run_exchange(mesh, comm.Allreduce(), C.NoneCompressor(), jnp.asarray(x))
+    np.testing.assert_allclose(out, x.mean(0), rtol=1e-5)
+
+
+def test_allreduce_none_sum(mesh, rng):
+    x = rng.normal(size=(W, 16)).astype(np.float32)
+    out = run_exchange(mesh, comm.Allreduce(), C.NoneCompressor(average=False),
+                       jnp.asarray(x))
+    np.testing.assert_allclose(out, x.sum(0), rtol=1e-5)
+
+
+def test_allgather_topk(mesh, rng):
+    x = rng.normal(size=(W, 50)).astype(np.float32)
+    comp = C.TopKCompressor(compress_ratio=0.2)
+    out = run_exchange(mesh, comm.Allgather(), comp, jnp.asarray(x))
+    # expected: mean over ranks of each rank's top-10-sparsified tensor
+    expect = np.zeros((W, 50), np.float32)
+    for r in range(W):
+        idx = np.argsort(-np.abs(x[r]))[:10]
+        expect[r, idx] = x[r, idx]
+    np.testing.assert_allclose(out, expect.mean(0), rtol=1e-5)
+
+
+def test_allgather_signsgd_majority_vote(mesh):
+    # 5 ranks positive, 3 negative at coord 0; opposite at coord 1
+    col0 = np.array([1, 1, 1, 1, 1, -1, -1, -1], np.float32)
+    x = np.stack([col0, -col0], axis=1)
+    comp = C.SignSGDCompressor()
+    out = run_exchange(mesh, comm.Allgather(), comp, jnp.asarray(x))
+    np.testing.assert_array_equal(out, [1.0, -1.0])
+
+
+def test_allgather_qsgd_per_rank_norms(mesh, rng):
+    """Each rank has a different norm; ctx-replication contract must hold."""
+    x = (rng.normal(size=(W, 40)) * np.arange(1, W + 1)[:, None]).astype(np.float32)
+    comp = C.QSGDCompressor(quantum_num=127)
+    out = run_exchange(mesh, comm.Allgather(), comp, jnp.asarray(x))
+    # error per rank bounded by its norm/q; mean over ranks
+    bound = np.linalg.norm(x, axis=1).sum() / 127 / W + 1e-5
+    assert np.max(np.abs(out - x.mean(0))) <= bound
+
+
+def test_allgather_randomk_shared_indices(mesh, rng):
+    x = rng.normal(size=(W, 30)).astype(np.float32)
+    comp = C.RandomKCompressor(compress_ratio=0.5)
+    out = run_exchange(mesh, comm.Allgather(), comp, jnp.asarray(x), seed=3)
+    # all ranks picked the same indices -> result is mean of x at those coords
+    nz = out != 0
+    assert nz.sum() == 15
+    np.testing.assert_allclose(out[nz], x.mean(0)[nz], rtol=1e-5)
+
+
+def test_broadcast_equals_allgather(mesh, rng):
+    x = rng.normal(size=(W, 24)).astype(np.float32)
+    comp = C.FP16Compressor()
+    a = run_exchange(mesh, comm.Allgather(), comp, jnp.asarray(x))
+    b = run_exchange(mesh, comm.Broadcast(), comp, jnp.asarray(x))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_powersgd_inside_compress(mesh, rng):
+    """PowerSGD's collectives run inside compress; empty payload path."""
+    x = rng.normal(size=(W, 12, 6)).astype(np.float32)
+    comp = C.PowerSGDCompressor(rank=6, axis_name="data")
+
+    out = run_exchange(mesh, comm.Allreduce(), comp, jnp.asarray(x))
+    # rank 6 >= min(n, m) = 6 -> reconstruction should approximate the mean
+    np.testing.assert_allclose(out, x.mean(0), atol=1e-3)
+
+
+def test_powersgd_1d_bypass(mesh, rng):
+    x = rng.normal(size=(W, 9)).astype(np.float32)
+    comp = C.PowerSGDCompressor(rank=2, axis_name="data")
+    out = run_exchange(mesh, comm.Allreduce(), comp, jnp.asarray(x))
+    np.testing.assert_allclose(out, x.mean(0), rtol=1e-5)
+
+
+def test_allreduce_int_payload_average_raises(mesh, rng):
+    x = rng.normal(size=(W, 16)).astype(np.float32)
+    try:
+        run_exchange(mesh, comm.Allreduce(), C.QSGDCompressor(quantum_num=64),
+                     jnp.asarray(x))
+        raised = False
+    except TypeError:
+        raised = True
+    assert raised

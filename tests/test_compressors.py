@@ -1,0 +1,240 @@
+"""Per-compressor property tests (round-trip, payload shape/dtype, semantics).
+
+The reference backs its algorithms with no tests at all; the semantics
+asserted here are transcribed from SURVEY.md §2.3 and the reference sources
+cited in each compressor's docstring.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grace_tpu import compressors as C
+
+KEY = jax.random.key(42)
+
+
+def _compress(comp, x, state=None, key=KEY):
+    if state is None:
+        state = comp.init_state(x)
+    return comp.compress(x, state, key)
+
+
+def _roundtrip(comp, x, key=KEY):
+    payload, ctx, _ = _compress(comp, x, key=key)
+    return comp.decompress(payload, ctx)
+
+
+def rand(shape, rng, scale=1.0):
+    return jnp.asarray(rng.normal(size=shape).astype(np.float32) * scale)
+
+
+def test_none_identity(rng):
+    x = rand((13, 7), rng)
+    out = _roundtrip(C.NoneCompressor(), x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_fp16_roundtrip(rng, dtype):
+    x = rand((64,), rng)
+    comp = C.FP16Compressor(dtype=dtype)
+    payload, ctx, _ = _compress(comp, x)
+    assert payload[0].dtype == jnp.dtype(dtype)
+    out = comp.decompress(payload, ctx)
+    assert out.dtype == x.dtype
+    tol = 0.04 if dtype == "bfloat16" else 0.01
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=tol, atol=tol)
+
+
+def test_topk_keeps_largest(rng):
+    x = rand((10, 10), rng)
+    comp = C.TopKCompressor(compress_ratio=0.1)
+    payload, ctx, _ = _compress(comp, x)
+    values, indices = payload
+    assert values.shape == (10,) and indices.shape == (10,)
+    out = comp.decompress(payload, ctx)
+    assert out.shape == x.shape
+    flat = np.asarray(x).ravel()
+    expect_idx = np.argsort(-np.abs(flat))[:10]
+    got = np.asarray(out).ravel()
+    # kept entries match original, everything else is zero
+    np.testing.assert_allclose(got[expect_idx], flat[expect_idx], rtol=1e-6)
+    mask = np.ones_like(flat, bool)
+    mask[expect_idx] = False
+    assert np.all(got[mask] == 0)
+
+
+def test_randomk_shared_seed(rng):
+    """Same rng key on every 'rank' -> identical ctx indices (the wire contract)."""
+    comp = C.RandomKCompressor(compress_ratio=0.25)
+    x1, x2 = rand((40,), rng), rand((40,), rng)
+    key = jax.random.key(7)
+    _, ctx1, _ = _compress(comp, x1, key=key)
+    _, ctx2, _ = _compress(comp, x2, key=key)
+    np.testing.assert_array_equal(np.asarray(ctx1[0]), np.asarray(ctx2[0]))
+    assert ctx1[0].shape == (10,)
+    # indices are distinct (sampling without replacement)
+    assert len(np.unique(np.asarray(ctx1[0]))) == 10
+
+
+def test_threshold_static_capacity(rng):
+    x = jnp.asarray([0.5, -0.001, 0.2, 0.0009, -0.9, 0.003])
+    comp = C.ThresholdCompressor(threshold=0.1, capacity_ratio=1.0)
+    payload, ctx, _ = _compress(comp, x)
+    out = np.asarray(comp.decompress(payload, ctx))
+    expect = np.where(np.abs(np.asarray(x)) > 0.1, np.asarray(x), 0.0)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_qsgd_bound(rng):
+    x = rand((257,), rng)
+    comp = C.QSGDCompressor(quantum_num=64)
+    payload, ctx, _ = _compress(comp, x)
+    levels, norm = payload
+    assert levels.dtype == jnp.int8
+    out = np.asarray(comp.decompress(payload, ctx))
+    # quantization error per element is at most norm/quantum_num
+    bound = float(norm) / 64 + 1e-6
+    assert np.max(np.abs(out - np.asarray(x))) <= bound
+
+
+def test_qsgd_int16_for_many_levels(rng):
+    comp = C.QSGDCompressor(quantum_num=256)
+    payload, _, _ = _compress(comp, rand((32,), rng))
+    assert payload[0].dtype == jnp.int16
+
+
+def test_terngrad_values(rng):
+    x = rand((500,), rng)
+    comp = C.TernGradCompressor()
+    payload, ctx, _ = _compress(comp, x)
+    out = np.asarray(comp.decompress(payload, ctx))
+    scalar = float(payload[1])
+    uniq = np.unique(out)
+    assert set(np.round(uniq / scalar).astype(int)) <= {-1, 0, 1}
+    # signs agree where nonzero
+    nz = out != 0
+    assert np.all(np.sign(out[nz]) == np.sign(np.asarray(x)[nz]))
+
+
+def test_terngrad_unbiased(rng):
+    """Stochastic ternarization is unbiased in expectation (clip aside)."""
+    x = jnp.asarray(rng.normal(size=2000).astype(np.float32) * 0.1)
+    comp = C.TernGradCompressor()
+
+    @jax.jit
+    def rt(key):
+        payload, ctx, _ = comp.compress(x, None, key)
+        return comp.decompress(payload, ctx)
+
+    outs = [np.asarray(rt(jax.random.key(i))) for i in range(200)]
+    mean = np.mean(outs, axis=0)
+    assert np.abs(mean - np.asarray(x)).mean() < 0.02
+
+
+def test_signsgd_majority_vote(rng):
+    comp = C.SignSGDCompressor()
+    assert comp.average is False
+    x = rand((33,), rng)
+    out = np.asarray(_roundtrip(comp, x))
+    np.testing.assert_array_equal(out, np.where(np.asarray(x) >= 0, 1.0, -1.0))
+    stacked = jnp.asarray([[1.0, 1, -1], [1, -1, -1], [-1, -1, -1]])
+    vote = np.asarray(comp.aggregate(stacked))
+    np.testing.assert_array_equal(vote, [1.0, -1.0, -1.0])
+
+
+def test_signum_momentum(rng):
+    comp = C.SignumCompressor(momentum=0.5)
+    x = jnp.asarray([1.0, -1.0, 4.0])
+    state = comp.init_state(x)
+    payload, ctx, state = comp.compress(x, state, KEY)
+    # first step: sign of raw gradient
+    np.testing.assert_array_equal(np.asarray(comp.decompress(payload, ctx)),
+                                  [1.0, -1.0, 1.0])
+    y = jnp.asarray([-4.0, -1.0, -1.0])
+    payload, ctx, state = comp.compress(y, state, KEY)
+    # m = 0.5*y + 0.5*m_prev = [-1.5, -1.0, 1.5]
+    np.testing.assert_array_equal(np.asarray(comp.decompress(payload, ctx)),
+                                  [-1.0, -1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(state["momentum"]), [-1.5, -1.0, 1.5])
+
+
+def test_efsignsgd_roundtrip(rng):
+    x = rand((100,), rng)
+    comp = C.EFSignSGDCompressor(lr=0.5)
+    payload, ctx, _ = _compress(comp, x)
+    out = np.asarray(comp.decompress(payload, ctx))
+    mean = float(np.mean(np.abs(np.asarray(x))))
+    np.testing.assert_allclose(np.abs(out), mean, rtol=1e-5)
+    assert np.all(np.sign(out) == np.where(np.asarray(x) >= 0, 1, -1))
+    # aggregate divides by lr
+    stacked = jnp.stack([x, x])
+    np.testing.assert_allclose(np.asarray(comp.aggregate(stacked)),
+                               np.asarray(x + x) / 0.5, rtol=1e-5)
+
+
+def test_onebit_means(rng):
+    x = jnp.asarray([-2.0, -4.0, 1.0, 3.0, 5.0])
+    comp = C.OneBitCompressor()
+    payload, ctx, _ = _compress(comp, x)
+    out = np.asarray(comp.decompress(payload, ctx))
+    np.testing.assert_allclose(out, [-3.0, -3.0, 3.0, 3.0, 3.0], rtol=1e-6)
+
+
+def test_onebit_all_positive(rng):
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    out = np.asarray(_roundtrip(C.OneBitCompressor(), x))
+    np.testing.assert_allclose(out, [2.0, 2.0, 2.0], rtol=1e-6)
+
+
+def test_natural_power_of_two(rng):
+    x = rand((1000,), rng)
+    comp = C.NaturalCompressor()
+    payload, ctx, _ = _compress(comp, x)
+    assert payload[0].dtype == jnp.uint8
+    out = np.asarray(comp.decompress(payload, ctx))
+    nz = out != 0
+    # every decompressed magnitude is a power of two
+    log2 = np.log2(np.abs(out[nz]))
+    np.testing.assert_allclose(log2, np.round(log2), atol=1e-6)
+    # signs preserved, magnitude within a factor of two
+    xs = np.asarray(x)[nz]
+    assert np.all(np.sign(out[nz]) == np.sign(xs))
+    ratio = np.abs(out[nz]) / np.abs(xs)
+    assert np.all(ratio <= 2.0 + 1e-6) and np.all(ratio >= 0.5 - 1e-6)
+
+
+def test_natural_unbiased(rng):
+    x = jnp.asarray([0.75] * 512, jnp.float32)
+    comp = C.NaturalCompressor()
+
+    @jax.jit
+    def rt(key):
+        payload, ctx, _ = comp.compress(x, None, key)
+        return comp.decompress(payload, ctx)
+
+    outs = [np.asarray(rt(jax.random.key(i))) for i in range(64)]
+    mean = np.mean(outs)
+    assert abs(mean - 0.75) < 0.02
+
+
+def test_dgc_selects_about_ratio(rng):
+    x = rand((10000,), rng)
+    comp = C.DgcCompressor(compress_ratio=0.05)
+    payload, ctx, _ = _compress(comp, x)
+    values, indices = payload
+    nnz = int(np.sum(np.asarray(values) != 0))
+    # refinement targets [0.7k, 1.3k]; sampling noise can leave an extra margin
+    assert 0.4 * 500 <= nnz <= 1.3 * 500 + 1
+    out = np.asarray(comp.decompress(payload, ctx))
+    flat = np.asarray(x)
+    sent = out != 0
+    np.testing.assert_allclose(out[sent], flat[sent], rtol=1e-6)
+
+
+def test_compressor_hashable():
+    """Frozen dataclasses: usable as static jit args / dict keys."""
+    assert hash(C.TopKCompressor(0.5)) == hash(C.TopKCompressor(0.5))
+    assert C.TopKCompressor(0.5) != C.TopKCompressor(0.25)

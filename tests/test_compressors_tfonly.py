@@ -1,0 +1,112 @@
+"""Tests for the four tf-only reference algorithms (sketch/u8bit/adaq/inceptionn)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grace_tpu import compressors as C
+
+KEY = jax.random.key(7)
+
+
+def rand(shape, rng, scale=1.0):
+    return jnp.asarray(rng.normal(size=shape).astype(np.float32) * scale)
+
+
+def _roundtrip(comp, x, key=KEY):
+    payload, ctx, _ = comp.compress(x, comp.init_state(x), key)
+    return payload, ctx, comp.decompress(payload, ctx)
+
+
+def test_sketch_bins_and_means(rng):
+    x = rand((2000,), rng)
+    comp = C.SketchCompressor(bins=64)
+    payload, ctx, out = _roundtrip(comp, x)
+    ids, means = payload
+    assert ids.dtype == jnp.uint8
+    assert means.shape == (64,)
+    # each value decodes to the mean of its quantile bin: error bounded by
+    # bin width; check rank correlation and overall closeness
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    assert np.quantile(err, 0.95) < 0.2  # 64 quantile bins over N(0,1)
+
+
+def test_sketch_uint16_for_many_bins(rng):
+    comp = C.SketchCompressor(bins=512)
+    payload, _, _ = _roundtrip(comp, rand((4096,), rng))
+    assert payload[0].dtype == jnp.uint16
+
+
+def test_u8bit_roundtrip(rng):
+    x = rand((1000,), rng)
+    comp = C.U8bitCompressor()
+    payload, ctx, out = _roundtrip(comp, x)
+    code, scale = payload
+    assert code.dtype == jnp.int8
+    out, x = np.asarray(out), np.asarray(x)
+    # nonuniform 8-bit: relative error small for large entries
+    big = np.abs(x) > 0.1 * np.abs(x).max()
+    rel = np.abs(out[big] - x[big]) / np.abs(x[big])
+    assert np.max(rel) < 0.15
+    assert np.all(np.sign(out[big]) == np.sign(x[big]))
+
+
+def test_u8bit_codebook_range():
+    from grace_tpu.compressors.u8bit import _dynamic_tree_codebook
+    book = _dynamic_tree_codebook()
+    assert book.shape == (127,)
+    assert np.all(np.diff(book) > 0)
+    assert book[0] < 1e-5 and 0.9 < book[-1] <= 1.0
+
+
+def test_adaq_half_means(rng):
+    x = rand((5000,), rng)
+    comp = C.AdaqCompressor(compress_ratio=0.05)
+    payload, ctx, out = _roundtrip(comp, x)
+    out, xs = np.asarray(out), np.asarray(x)
+    pos_sent = out > 0
+    neg_sent = out < 0
+    assert pos_sent.sum() > 0 and neg_sent.sum() > 0
+    # all transmitted positives share one value (the half mean); same for negatives
+    assert np.unique(out[pos_sent]).size == 1
+    assert np.unique(out[neg_sent]).size == 1
+    # transmitted coords really are large-magnitude entries of matching sign
+    assert np.all(xs[pos_sent] > 0) and np.all(xs[neg_sent] < 0)
+    # selection is in the right ballpark of ratio·numel per half
+    assert pos_sent.sum() < 0.15 * 5000 and neg_sent.sum() < 0.15 * 5000
+
+
+def test_inceptionn_error_bound(rng):
+    x = rand((4000,), rng, scale=0.05)
+    comp = C.InceptionNCompressor(error_bound=1e-3)
+    payload, ctx, out = _roundtrip(comp, x)
+    v16, v32, idx = payload
+    assert v16.dtype == jnp.uint16
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    # dropped values are < 2^-10+eps; truncation error bounded by ulp at scale
+    assert err.max() < 2e-3
+
+
+def test_inceptionn_overflow_lane_exact(rng):
+    x = jnp.asarray([3.5, -2.25, 0.001, 0.5, -0.125] + [0.01] * 27,
+                    jnp.float32)
+    comp = C.InceptionNCompressor(error_bound=1e-4, overflow_ratio=0.25)
+    payload, ctx, out = _roundtrip(comp, x)
+    out = np.asarray(out)
+    # values >= 1.0 are exactly preserved via the fp32 lane
+    np.testing.assert_array_equal(out[:2], [3.5, -2.25])
+    # mid-range value within relative truncation error
+    np.testing.assert_allclose(out[3], 0.5, rtol=1e-3)
+    assert abs(out[4] - (-0.125)) / 0.125 < 1e-2
+
+
+def test_inceptionn_overflow_clamps_when_capacity_exceeded():
+    # 8 values >= 1 but capacity only 1 -> the rest clamp to ~1.0, sign kept
+    x = jnp.asarray([4.0, -3.0, 2.0, 1.5, 1.25, 1.1, 1.05, 1.01],
+                    jnp.float32)
+    comp = C.InceptionNCompressor(error_bound=1e-4, overflow_ratio=0.125)
+    _, _, out = _roundtrip(comp, x)
+    out = np.asarray(out)
+    np.testing.assert_allclose(out[0], 4.0)       # top-1 exact
+    np.testing.assert_allclose(out[1], -1.0, rtol=1e-3)  # clamped, sign kept
+    assert np.all(np.abs(out[2:]) <= 1.0)

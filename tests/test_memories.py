@@ -1,0 +1,85 @@
+"""Memory (error-feedback) semantics; reference cites in each class docstring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grace_tpu import compressors as C
+from grace_tpu import memories as M
+
+KEY = jax.random.key(0)
+
+
+def test_none_memory_passthrough():
+    mem = M.NoneMemory()
+    x = jnp.asarray([1.0, 2.0])
+    st = mem.init_state(x)
+    out, st = mem.compensate(x, st)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    assert mem.update(out, (x,), None, C.NoneCompressor(), st) is st
+
+
+def test_residual_accumulates(rng):
+    """Error feedback: what top-k drops this step comes back next step."""
+    mem = M.ResidualMemory()
+    comp = C.TopKCompressor(compress_ratio=0.5)
+    x = jnp.asarray([10.0, 1.0, -8.0, 0.5])
+    st = mem.init_state(x)
+    c, st = mem.compensate(x, st)
+    payload, ctx, _ = comp.compress(c, None, KEY)
+    st = mem.update(c, payload, ctx, comp, st)
+    # top-2 sent {10, -8}; residual keeps {1.0, 0.5}
+    np.testing.assert_allclose(np.asarray(st), [0.0, 1.0, 0.0, 0.5])
+    # next step: dropped mass is compensated in
+    y = jnp.asarray([0.0, 0.0, 0.0, 0.0])
+    c2, _ = mem.compensate(y, st)
+    np.testing.assert_allclose(np.asarray(c2), [0.0, 1.0, 0.0, 0.5])
+
+
+def test_residual_beta_gamma():
+    mem = M.ResidualMemory(beta=0.5, gamma=2.0)
+    st = jnp.asarray([4.0])
+    out, _ = mem.compensate(jnp.asarray([1.0]), st)
+    np.testing.assert_allclose(np.asarray(out), [0.5 * 4.0 + 2.0 * 1.0])
+
+
+def test_efsignsgd_memory_lr_scaling():
+    mem = M.EFSignSGDMemory(lr=0.25)
+    x = jnp.asarray([2.0, -2.0])
+    st = mem.init_state(x)
+    out, st = mem.compensate(x, st)
+    np.testing.assert_allclose(np.asarray(out), [0.5, -0.5])
+
+
+def test_dgc_memory_momentum_and_masking():
+    mem = M.DgcMemory(momentum=0.5)
+    comp = C.DgcCompressor(compress_ratio=0.5, sample_ratio=1.0)
+    x = jnp.asarray([5.0, 0.1, -4.0, 0.2])
+    st = mem.init_state(x)
+    c, st = mem.compensate(x, st)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(x))  # first step: u = g, v = u
+    payload, ctx, _ = comp.compress(c, None, KEY)
+    st = mem.update(c, payload, ctx, comp, st)
+    # transmitted coords are zeroed in both accumulators
+    sent = np.asarray(comp.decompress(payload, ctx)) != 0
+    assert np.all(np.asarray(st["residual"])[sent] == 0)
+    assert np.all(np.asarray(st["gradient"])[sent] == 0)
+    # non-transmitted coords retain accumulation
+    assert np.all(np.asarray(st["gradient"])[~sent] != 0)
+
+
+def test_powersgd_memory_1d_bypass():
+    mem = M.PowerSGDMemory()
+    x = jnp.asarray([1.0, 2.0])
+    assert mem.init_state(x) is None
+    out, st = mem.compensate(x, None)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    assert mem.update(out, (x,), None, C.NoneCompressor(), None) is None
+
+
+def test_powersgd_memory_residual_2d(rng):
+    mem = M.PowerSGDMemory()
+    x = jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32))
+    st = mem.init_state(x)
+    out, _ = mem.compensate(x, st)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))

@@ -1,0 +1,24 @@
+import jax.numpy as jnp
+import numpy as np
+
+from grace_tpu.ops import pack_2bit, pack_bits, unpack_2bit, unpack_bits
+
+
+def test_pack_bits_roundtrip(rng):
+    for n in [1, 7, 8, 9, 64, 1000]:
+        bits = rng.integers(0, 2, size=n).astype(bool)
+        packed = pack_bits(jnp.asarray(bits))
+        assert packed.dtype == jnp.uint8
+        assert packed.shape == (-(-n // 8),)
+        out = unpack_bits(packed, n)
+        np.testing.assert_array_equal(np.asarray(out), bits)
+
+
+def test_pack_2bit_roundtrip(rng):
+    for n in [1, 3, 4, 5, 17, 1000]:
+        codes = rng.integers(0, 4, size=n).astype(np.uint8)
+        packed = pack_2bit(jnp.asarray(codes))
+        assert packed.dtype == jnp.uint8
+        assert packed.shape == (-(-n // 4),)
+        out = unpack_2bit(packed, n)
+        np.testing.assert_array_equal(np.asarray(out), codes)
