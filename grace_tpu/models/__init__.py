@@ -1,0 +1,17 @@
+"""grace-tpu model zoo: functional models for the BASELINE.json configs.
+
+Each model module exposes ``init(key, ...) -> (params, state)`` and
+``apply(params, state, x, *, train) -> (out, new_state)`` — params/state are
+plain pytrees, so GRACE memory state mirrors them leaf-for-leaf and
+checkpoints with orbax alongside them.
+
+* ``lenet``         — MNIST CNN (reference examples/torch/pytorch_mnist.py:73-89)
+* ``resnet_cifar``  — cifar10-fast DAWNBench net (examples/dist/CIFAR10-dawndist/dawn.py:60-97)
+* ``resnet``        — ResNet-50/101/152 v1.5 (torchvision stand-in used by
+                      examples/torch/pytorch_synthetic_benchmark.py:49)
+* ``transformer``   — BERT-style encoder (BASELINE.json BERT/PowerSGD config)
+"""
+
+from grace_tpu.models import layers, lenet, resnet, resnet_cifar, transformer
+
+__all__ = ["layers", "lenet", "resnet", "resnet_cifar", "transformer"]

@@ -1,0 +1,41 @@
+"""LeNet-style MNIST CNN — the reference's flagship example model.
+
+Reference: examples/torch/pytorch_mnist.py:73-89 (conv 10@5x5 → pool → conv
+20@5x5 → pool → fc 50 → fc 10) and the TF twins
+(examples/tensorflow/tensorflow2_mnist.py:30-41). Stateless (no BN), so
+``state`` is an empty dict.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.models import layers as L
+
+
+def init(key: jax.Array) -> Tuple[L.Params, L.ModelState]:
+    k = L.split_keys(key, 4)
+    params = {
+        "conv1": L.conv_init(k[0], 5, 5, 1, 10, use_bias=True),
+        "conv2": L.conv_init(k[1], 5, 5, 10, 20, use_bias=True),
+        "fc1": L.dense_init(k[2], 320, 50),
+        "fc2": L.dense_init(k[3], 50, 10),
+    }
+    return params, {}
+
+
+def apply(params: L.Params, state: L.ModelState, x: jax.Array, *,
+          train: bool = True) -> Tuple[jax.Array, L.ModelState]:
+    """x: (N, 28, 28, 1) → logits (N, 10)."""
+    x = L.conv_apply(params["conv1"], x, padding="VALID")
+    x = L.max_pool(x, 2)
+    x = jax.nn.relu(x)
+    x = L.conv_apply(params["conv2"], x, padding="VALID")
+    x = L.max_pool(x, 2)
+    x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(L.dense_apply(params["fc1"], x))
+    return L.dense_apply(params["fc2"], x), state

@@ -1,0 +1,110 @@
+"""ResNet-50 (v1.5) — the reference's synthetic-benchmark workhorse.
+
+The reference pulls `torchvision.models.resnet50` / keras ResNet50
+(examples/torch/pytorch_synthetic_benchmark.py:49,
+examples/tensorflow/tensorflow2_synthetic_benchmark.py:63); grace-tpu ships a
+functional implementation so the benchmark stack has zero framework deps.
+v1.5 variant (stride-2 in the 3x3 of the bottleneck), NHWC/bf16-friendly —
+this is the BASELINE.json north-star model (Top-K 1% + residual memory).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.models import layers as L
+
+# depth -> (block counts)
+_STAGES = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+def _bottleneck_init(key, cin, cmid, stride):
+    k = L.split_keys(key, 4)
+    cout = cmid * 4
+    p, s = {}, {}
+    p["conv1"] = L.conv_init(k[0], 1, 1, cin, cmid)
+    p["bn1"], s["bn1"] = L.bn_init(cmid)
+    p["conv2"] = L.conv_init(k[1], 3, 3, cmid, cmid)
+    p["bn2"], s["bn2"] = L.bn_init(cmid)
+    p["conv3"] = L.conv_init(k[2], 1, 1, cmid, cout)
+    p["bn3"], s["bn3"] = L.bn_init(cout)
+    if stride != 1 or cin != cout:
+        p["proj"] = L.conv_init(k[3], 1, 1, cin, cout)
+        p["proj_bn"], s["proj_bn"] = L.bn_init(cout)
+    return p, s
+
+
+def _bottleneck_apply(p, s, x, stride, train):
+    ns = {}
+    shortcut = x
+    y = L.conv_apply(p["conv1"], x)
+    y, ns["bn1"] = L.bn_apply(p["bn1"], s["bn1"], y, train)
+    y = jax.nn.relu(y)
+    y = L.conv_apply(p["conv2"], y, stride=stride)  # v1.5: stride on the 3x3
+    y, ns["bn2"] = L.bn_apply(p["bn2"], s["bn2"], y, train)
+    y = jax.nn.relu(y)
+    y = L.conv_apply(p["conv3"], y)
+    y, ns["bn3"] = L.bn_apply(p["bn3"], s["bn3"], y, train)
+    if "proj" in p:
+        shortcut = L.conv_apply(p["proj"], x, stride=stride)
+        shortcut, ns["proj_bn"] = L.bn_apply(p["proj_bn"], s["proj_bn"],
+                                             shortcut, train)
+    return jax.nn.relu(y + shortcut), ns
+
+
+def init(key: jax.Array, depth: int = 50, num_classes: int = 1000
+         ) -> Tuple[L.Params, L.ModelState]:
+    blocks = _STAGES[depth]
+    keys = L.split_keys(key, 2 + sum(blocks))
+    params, state = {}, {}
+    params["stem"] = L.conv_init(keys[0], 7, 7, 3, 64)
+    params["stem_bn"], state["stem_bn"] = L.bn_init(64)
+    ki = 1
+    cin = 64
+    for stage, n in enumerate(blocks):
+        cmid = 64 * (2 ** stage)
+        for b in range(n):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            name = f"s{stage}b{b}"
+            params[name], state[name] = _bottleneck_init(keys[ki], cin, cmid,
+                                                         stride)
+            cin = cmid * 4
+            ki += 1
+    params["fc"] = L.dense_init(keys[ki], cin, num_classes, init="glorot")
+    return params, state
+
+
+def _stages_from_params(params: L.Params) -> Tuple[int, ...]:
+    """Recover per-stage block counts from the param dict, so ``apply`` always
+    matches the depth the params were initialised with."""
+    counts = [0, 0, 0, 0]
+    for name in params:
+        m = re.fullmatch(r"s(\d+)b(\d+)", name)
+        if m:
+            stage, block = int(m.group(1)), int(m.group(2))
+            counts[stage] = max(counts[stage], block + 1)
+    return tuple(counts)
+
+
+def apply(params: L.Params, state: L.ModelState, x: jax.Array, *,
+          train: bool = True) -> Tuple[jax.Array, L.ModelState]:
+    """x: (N, H, W, 3) NHWC → logits (N, num_classes)."""
+    ns = {}
+    y = L.conv_apply(params["stem"], x, stride=2)
+    y, ns["stem_bn"] = L.bn_apply(params["stem_bn"], state["stem_bn"], y, train)
+    y = jax.nn.relu(y)
+    y = jnp.pad(y, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=-jnp.inf
+                if jnp.issubdtype(y.dtype, jnp.floating) else 0)
+    y = L.max_pool(y, 3, 2)
+    for stage, n in enumerate(_stages_from_params(params)):
+        for b in range(n):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            name = f"s{stage}b{b}"
+            y, ns[name] = _bottleneck_apply(params[name], state[name], y,
+                                            stride, train)
+    y = L.global_avg_pool(y)
+    return L.dense_apply(params["fc"], y.astype(jnp.float32)), ns

@@ -1,0 +1,75 @@
+"""cifar10-fast ResNet — the reference's DAWNBench model.
+
+Reference: examples/dist/CIFAR10-dawndist/dawn.py:60-97 builds (via a nested
+dict graph) the davidcpage/cifar10-fast "basic net + 3 residual layers"
+architecture: prep conv 64 → layer1 conv 128 + pool + residual(128,128) →
+layer2 conv 256 + pool → layer3 conv 512 + pool + residual(512,512) → global
+maxpool → linear ×0.125 logit scale. Every conv is conv→BN→ReLU
+(conv_bn, dawn.py:60-66). Re-expressed here as explicit functional blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.models import layers as L
+
+
+def _conv_bn_init(key, cin, cout):
+    p_bn, s_bn = L.bn_init(cout)
+    return {"conv": L.conv_init(key, 3, 3, cin, cout), "bn": p_bn}, {"bn": s_bn}
+
+
+def _conv_bn_apply(p, s, x, train):
+    x = L.conv_apply(p["conv"], x)
+    x, s_bn = L.bn_apply(p["bn"], s["bn"], x, train)
+    return jax.nn.relu(x), {"bn": s_bn}
+
+
+def _residual_init(key, c):
+    k1, k2 = jax.random.split(key)
+    p1, s1 = _conv_bn_init(k1, c, c)
+    p2, s2 = _conv_bn_init(k2, c, c)
+    return {"res1": p1, "res2": p2}, {"res1": s1, "res2": s2}
+
+
+def _residual_apply(p, s, x, train):
+    y, s1 = _conv_bn_apply(p["res1"], s["res1"], x, train)
+    y, s2 = _conv_bn_apply(p["res2"], s["res2"], y, train)
+    return x + y, {"res1": s1, "res2": s2}
+
+
+def init(key: jax.Array, num_classes: int = 10
+         ) -> Tuple[L.Params, L.ModelState]:
+    k = L.split_keys(key, 7)
+    params, state = {}, {}
+    params["prep"], state["prep"] = _conv_bn_init(k[0], 3, 64)
+    params["l1"], state["l1"] = _conv_bn_init(k[1], 64, 128)
+    params["l1res"], state["l1res"] = _residual_init(k[2], 128)
+    params["l2"], state["l2"] = _conv_bn_init(k[3], 128, 256)
+    params["l3"], state["l3"] = _conv_bn_init(k[4], 256, 512)
+    params["l3res"], state["l3res"] = _residual_init(k[5], 512)
+    params["fc"] = L.dense_init(k[6], 512, num_classes, use_bias=False)
+    return params, state
+
+
+def apply(params: L.Params, state: L.ModelState, x: jax.Array, *,
+          train: bool = True) -> Tuple[jax.Array, L.ModelState]:
+    """x: (N, 32, 32, 3) → logits (N, num_classes)."""
+    ns = {}
+    x, ns["prep"] = _conv_bn_apply(params["prep"], state["prep"], x, train)
+    x, ns["l1"] = _conv_bn_apply(params["l1"], state["l1"], x, train)
+    x = L.max_pool(x, 2)
+    x, ns["l1res"] = _residual_apply(params["l1res"], state["l1res"], x, train)
+    x, ns["l2"] = _conv_bn_apply(params["l2"], state["l2"], x, train)
+    x = L.max_pool(x, 2)
+    x, ns["l3"] = _conv_bn_apply(params["l3"], state["l3"], x, train)
+    x = L.max_pool(x, 2)
+    x, ns["l3res"] = _residual_apply(params["l3res"], state["l3res"], x, train)
+    # global max pool (dawn.py:92 MaxPool2d(4) on the 4x4 map)
+    x = jnp.max(x, axis=(1, 2))
+    logits = L.dense_apply(params["fc"], x) * 0.125  # dawn.py:95 Mul(0.125)
+    return logits, ns

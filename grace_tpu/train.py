@@ -24,7 +24,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from grace_tpu.core import DEFAULT_AXIS
 
-__all__ = ["TrainState", "make_train_step", "make_eval_step"]
+__all__ = ["TrainState", "StatefulTrainState", "make_train_step",
+           "make_stateful_train_step", "make_eval_step",
+           "init_train_state", "init_stateful_train_state"]
 
 
 class TrainState(NamedTuple):
@@ -64,6 +66,56 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
 
     donate_argnums = (0,) if donate else ()
     return jax.jit(sharded, donate_argnums=donate_argnums)
+
+
+class StatefulTrainState(NamedTuple):
+    params: Any
+    model_state: Any   # e.g. BatchNorm running stats
+    opt_state: Any
+
+
+def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
+                                               Tuple[jax.Array, Any]],
+                             optimizer: optax.GradientTransformation,
+                             mesh: Mesh,
+                             axis_name: str = DEFAULT_AXIS,
+                             donate: bool = True,
+                             sync_model_state: bool = True):
+    """Like :func:`make_train_step` for models with non-param state (BN stats).
+
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
+    ``sync_model_state`` pmeans the new model state across ranks so running
+    statistics stay replicated (the reference's DDP examples leave BN stats
+    rank-local and implicitly use rank 0's at save time; replication is the
+    deterministic version of the same thing, and the stats are tiny).
+    """
+
+    def device_step(state: StatefulTrainState, batch):
+        (loss, mstate), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.model_state, batch)
+        if sync_model_state:
+            mstate = jax.tree_util.tree_map(
+                lambda m: lax.pmean(m, axis_name), mstate)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        loss = lax.pmean(loss, axis_name)
+        return StatefulTrainState(params, mstate, opt_state), loss
+
+    sharded = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(P(), P(axis_name)),
+        out_specs=(P(), P()),
+        check_vma=False)
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(sharded, donate_argnums=donate_argnums)
+
+
+def init_stateful_train_state(params: Any, model_state: Any,
+                              optimizer: optax.GradientTransformation
+                              ) -> StatefulTrainState:
+    return StatefulTrainState(params=params, model_state=model_state,
+                              opt_state=optimizer.init(params))
 
 
 def make_eval_step(metric_fn: Callable[[Any, Any], Any], mesh: Mesh,

@@ -1,0 +1,110 @@
+"""Model zoo tests: shapes, state threading, and end-to-end compressed training.
+
+The reference's only QA was examples-as-smoke-tests (SURVEY.md §4); here the
+same coverage is a real test suite: forward shapes for each model, BN state
+updates, and a convergence check of the FULL grace pipeline (topk + residual
++ allgather over the 8-device mesh) on a separable toy problem.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from grace_tpu import grace_from_params
+from grace_tpu.models import lenet, resnet, resnet_cifar, transformer
+from grace_tpu.parallel import batch_sharded, replicated
+from grace_tpu.train import (init_stateful_train_state,
+                             make_stateful_train_step)
+
+
+def test_lenet_forward():
+    params, state = lenet.init(jax.random.key(0))
+    x = jnp.zeros((4, 28, 28, 1))
+    logits, _ = lenet.apply(params, state, x)
+    assert logits.shape == (4, 10)
+    assert jnp.all(jnp.isfinite(logits))
+
+
+def test_resnet_cifar_forward_and_state():
+    params, state = resnet_cifar.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    logits, new_state = resnet_cifar.apply(params, state, x, train=True)
+    assert logits.shape == (2, 10)
+    # BN running stats must move in train mode…
+    moved = jax.tree_util.tree_map(
+        lambda a, b: bool(jnp.any(a != b)), state, new_state)
+    assert any(jax.tree_util.tree_leaves(moved))
+    # …and stay fixed in eval mode.
+    logits_e, state_e = resnet_cifar.apply(params, new_state, x, train=False)
+    same = jax.tree_util.tree_map(
+        lambda a, b: bool(jnp.all(a == b)), new_state, state_e)
+    assert all(jax.tree_util.tree_leaves(same))
+    assert jnp.all(jnp.isfinite(logits_e))
+
+
+def test_resnet50_forward_tiny():
+    params, state = resnet.init(jax.random.key(0), depth=50, num_classes=10)
+    x = jax.random.normal(jax.random.key(1), (2, 64, 64, 3))
+    logits, _ = resnet.apply(params, state, x, train=True)
+    assert logits.shape == (2, 10)
+    assert jnp.all(jnp.isfinite(logits))
+
+
+def test_transformer_forward_and_mlm():
+    cfg = transformer.tiny()
+    params, state = transformer.init(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    mask = jnp.ones((2, 16), bool)
+    logits, _ = transformer.apply(params, state, ids, cfg=cfg, mask=mask)
+    assert logits.shape == (2, cfg.num_classes)
+    mlm = transformer.mlm_logits(params, ids, cfg, mask)
+    assert mlm.shape == (2, 16, cfg.vocab_size)
+    assert jnp.all(jnp.isfinite(mlm))
+
+
+def test_transformer_bf16_matches_shape():
+    cfg = transformer.tiny()
+    params, state = transformer.init(jax.random.key(0), cfg)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    logits, _ = transformer.apply(params, state, ids, cfg=cfg,
+                                  dtype=jnp.bfloat16)
+    assert logits.dtype == jnp.float32  # head always computes fp32
+    assert jnp.all(jnp.isfinite(logits))
+
+
+@pytest.mark.parametrize("grace_params", [
+    {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+     "communicator": "allgather"},
+    {"compressor": "none", "memory": "none", "communicator": "allreduce"},
+])
+def test_end_to_end_compressed_training(mesh, grace_params):
+    """LeNet on a separable toy problem: loss must drop under compression."""
+    params, mstate = lenet.init(jax.random.key(0))
+    grace = grace_from_params(grace_params)
+    optimizer = optax.chain(grace.transform(seed=0), optax.sgd(0.05))
+
+    # Separable synthetic "digits": class mean patterns + noise.
+    rng = np.random.default_rng(0)
+    protos = rng.standard_normal((10, 28, 28, 1)).astype(np.float32)
+    y = np.tile(np.arange(10), 8)[:64]
+    x = protos[y] + 0.1 * rng.standard_normal((64, 28, 28, 1)).astype(np.float32)
+    batch = jax.device_put((jnp.asarray(x), jnp.asarray(y)),
+                           batch_sharded(mesh))
+
+    def loss_fn(params, mstate, batch):
+        xb, yb = batch
+        logits, new_mstate = lenet.apply(params, mstate, xb)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        return loss.mean(), new_mstate
+
+    step = make_stateful_train_step(loss_fn, optimizer, mesh)
+    ts = jax.device_put(init_stateful_train_state(params, mstate, optimizer),
+                        replicated(mesh))
+
+    ts, first = step(ts, batch)
+    for _ in range(30):
+        ts, loss = step(ts, batch)
+    assert jnp.isfinite(loss)
+    assert float(loss) < float(first) * 0.5, (first, loss)
