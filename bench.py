@@ -15,7 +15,6 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -47,16 +46,13 @@ def _build_step(grace_params, mesh, num_classes, sgd_lr=1e-3):
 
 
 def _throughput(step, ts, batch, n_batches, warmup=2):
-    for _ in range(warmup):
-        ts, loss = step(ts, batch)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(n_batches):
-        ts, loss = step(ts, batch)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    n_images = batch[1].shape[0] * n_batches
-    return n_images / dt
+    from grace_tpu.utils import StepTimer
+    timer = StepTimer(warmup=warmup)
+    for _ in range(warmup + n_batches):
+        with timer.step():
+            ts, loss = step(ts, batch)
+            timer.sync_on(loss)
+    return timer.throughput(items_per_step=batch[1].shape[0])
 
 
 def main():

@@ -1,0 +1,24 @@
+"""Observability utilities: loggers, timers, compression metrics, profiling.
+
+The reference's observability is scattered ad-hoc through its examples
+(SURVEY.md §5): a `Timer` with pluggable device synch
+(examples/dist/CIFAR10-dawndist/core.py:14-27), aligned-column stdout
+(`TableLogger`, core.py:33-39), DAWNBench TSV output (`TSVLogger`,
+dawn.py:72-81), and rank-0-only printing
+(examples/torch/pytorch_synthetic_benchmark.py:169-172). grace-tpu promotes
+these to a framework module and adds what the reference never measured:
+per-algorithm bytes-on-wire / compression-ratio accounting (`wire_report`).
+"""
+
+from grace_tpu.utils.logging import (TableLogger, Timer, TSVLogger, localtime,
+                                     rank_zero_only, rank_zero_print)
+from grace_tpu.utils.metrics import (CompressionReport, LeafReport,
+                                     payload_nbytes, wire_report)
+from grace_tpu.utils.profiling import StepTimer, trace
+
+__all__ = [
+    "TableLogger", "TSVLogger", "Timer", "localtime",
+    "rank_zero_only", "rank_zero_print",
+    "CompressionReport", "LeafReport", "payload_nbytes", "wire_report",
+    "StepTimer", "trace",
+]

@@ -1,0 +1,125 @@
+"""Loggers and timers for training loops.
+
+Behavioral parity targets (re-designed, not copied):
+
+* ``Timer`` — segment/total wall-clock timing with a pluggable sync hook
+  (reference: examples/dist/CIFAR10-dawndist/core.py:14-27, which used
+  ``torch.cuda.synchronize``; on TPU the right hook is
+  ``jax.block_until_ready`` on a step output, or ``jax.effects_barrier``).
+* ``TableLogger`` — fixed-width column stdout whose header is latched from
+  the first row (reference: core.py:33-39).
+* ``TSVLogger`` — DAWNBench submission format ``epoch\thours\ttop1Accuracy``
+  (reference: dawn.py:72-81).
+* rank-0-only emission — the reference guards prints with ``hvd.rank()==0``
+  (pytorch_synthetic_benchmark.py:169-172); here the guard is
+  ``jax.process_index()==0``.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Mapping, Optional, Sequence, TextIO
+
+import jax
+
+__all__ = ["Timer", "TableLogger", "TSVLogger", "localtime",
+           "rank_zero_only", "rank_zero_print"]
+
+
+def localtime() -> str:
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Decorator: run ``fn`` only on process 0 (multi-host controller idiom)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if jax.process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped
+
+
+@rank_zero_only
+def rank_zero_print(*args, **kwargs) -> None:
+    print(*args, **kwargs)
+
+
+class Timer:
+    """Segment timer: each call returns the time since the previous call.
+
+    ``sync`` runs before every reading so asynchronously dispatched device
+    work is included — pass ``lambda: jax.block_until_ready(out)`` on a live
+    output, or ``jax.effects_barrier``. ``include_in_total=False`` excludes a
+    segment (e.g. validation) from ``total_time``, the DAWNBench accounting
+    rule the reference follows (core.py:20-26).
+    """
+
+    def __init__(self, sync: Optional[Callable[[], None]] = None):
+        self.sync = sync or (lambda: None)
+        self.sync()
+        self._last = time.perf_counter()
+        self.total_time = 0.0
+
+    def __call__(self, include_in_total: bool = True) -> float:
+        self.sync()
+        now = time.perf_counter()
+        delta = now - self._last
+        self._last = now
+        if include_in_total:
+            self.total_time += delta
+        return delta
+
+
+class TableLogger:
+    """Aligned-column stdout logger; header latched from the first row's keys."""
+
+    def __init__(self, width: int = 12, stream: Optional[TextIO] = None):
+        self.width = width
+        self.stream = stream
+        self._keys: Optional[Sequence[str]] = None
+
+    def _emit(self, line: str) -> None:
+        print(line, file=self.stream)
+
+    def append(self, row: Mapping[str, object]) -> None:
+        if self._keys is None:
+            self._keys = list(row.keys())
+            self._emit(" ".join(f"{k:>{self.width}s}" for k in self._keys))
+        cells = []
+        for k in self._keys:
+            v = row[k]
+            if isinstance(v, float):
+                cells.append(f"{v:{self.width}.4f}")
+            else:
+                cells.append(f"{v!s:>{self.width}s}")
+        self._emit(" ".join(cells))
+
+
+class TSVLogger:
+    """DAWNBench-format log: ``epoch\thours\ttop1Accuracy`` rows.
+
+    ``append`` takes the same row dict as :class:`TableLogger` with keys
+    ``epoch``, ``total time`` (seconds), ``test acc`` (fraction in [0,1]).
+    """
+
+    HEADER = "epoch\thours\ttop1Accuracy"
+
+    def __init__(self):
+        self._rows = [self.HEADER]
+
+    def append(self, row: Mapping[str, object]) -> None:
+        epoch = row["epoch"]
+        hours = float(row["total time"]) / 3600.0
+        acc = float(row["test acc"]) * 100.0
+        self._rows.append(f"{epoch}\t{hours:.8f}\t{acc:.2f}")
+
+    def __str__(self) -> str:
+        return "\n".join(self._rows)
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(str(self) + "\n")

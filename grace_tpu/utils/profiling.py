@@ -1,0 +1,89 @@
+"""Profiling helpers: XLA trace capture and honest step timing.
+
+Replaces the reference's print-driven instrumentation (SURVEY.md §5:
+`torch.cuda.synchronize()` + wall-clock prints left in
+grace_dl/torch/compressor/qsgd.py:14-15 and examples). On TPU the profiler
+of record is ``jax.profiler`` (Perfetto/TensorBoard traces of the XLA
+schedule, including ICI collective overlap); ``StepTimer`` gives cheap
+steady-state throughput numbers with correct async-dispatch handling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["trace", "StepTimer"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a device trace viewable in TensorBoard/Perfetto/XProf."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Per-step wall-clock stats that respect JAX's async dispatch.
+
+    Usage::
+
+        timer = StepTimer(warmup=2)
+        for batch in batches:
+            with timer.step():
+                state, loss = train_step(state, batch)
+                timer.sync_on(loss)     # block on a step OUTPUT, not the world
+
+    ``mean_sec``/``p50_sec`` skip the warmup steps (compile + autotune).
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self._times: List[float] = []
+        self._sync_target = None
+
+    def sync_on(self, out) -> None:
+        self._sync_target = out
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException:
+            self._sync_target = None  # don't let a failed step poison the next
+            raise
+        if self._sync_target is not None:
+            jax.block_until_ready(self._sync_target)
+            self._sync_target = None
+        self._times.append(time.perf_counter() - t0)
+
+    @property
+    def steady(self) -> np.ndarray:
+        if not self._times:
+            raise RuntimeError("StepTimer has no recorded steps")
+        return np.asarray(self._times[self.warmup:] or self._times)
+
+    @property
+    def mean_sec(self) -> float:
+        return float(self.steady.mean())
+
+    @property
+    def p50_sec(self) -> float:
+        return float(np.median(self.steady))
+
+    def throughput(self, items_per_step: int) -> float:
+        return items_per_step / self.mean_sec
+
+    def confidence95(self, items_per_step: int) -> float:
+        """±1.96σ half-width on items/sec (reference's reporting convention,
+        examples/torch/pytorch_synthetic_benchmark.py:186-198)."""
+        per_step = items_per_step / self.steady
+        return float(1.96 * per_step.std())

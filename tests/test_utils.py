@@ -1,0 +1,107 @@
+"""Tests for grace_tpu.utils: loggers, timers, wire metrics."""
+
+import io
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grace_tpu import compressors as C
+from grace_tpu.utils import (StepTimer, TableLogger, Timer, TSVLogger,
+                             payload_nbytes, wire_report)
+
+
+class TestTimer:
+    def test_segments_and_total(self):
+        t = Timer()
+        time.sleep(0.01)
+        d1 = t()
+        time.sleep(0.01)
+        d2 = t(include_in_total=False)
+        assert d1 >= 0.01 and d2 >= 0.01
+        assert t.total_time == pytest.approx(d1)
+
+    def test_sync_hook_called(self):
+        calls = []
+        t = Timer(sync=lambda: calls.append(1))
+        t()
+        assert len(calls) == 2  # once at init, once per reading
+
+
+class TestTableLogger:
+    def test_header_latched_and_aligned(self):
+        buf = io.StringIO()
+        log = TableLogger(width=8, stream=buf)
+        log.append({"epoch": 1, "loss": 0.5})
+        log.append({"epoch": 2, "loss": 0.25, "extra": "ignored"})
+        lines = buf.getvalue().strip().split("\n")
+        assert len(lines) == 3
+        assert "epoch" in lines[0] and "loss" in lines[0]
+        assert "ignored" not in lines[2]  # keys latched from first row
+        assert "0.2500" in lines[2]
+
+
+class TestTSVLogger:
+    def test_dawnbench_format(self, tmp_path):
+        log = TSVLogger()
+        log.append({"epoch": 1, "total time": 3600.0, "test acc": 0.9408})
+        s = str(log)
+        lines = s.split("\n")
+        assert lines[0] == "epoch\thours\ttop1Accuracy"
+        assert lines[1] == "1\t1.00000000\t94.08"
+        p = tmp_path / "logs.tsv"
+        log.write(str(p))
+        assert p.read_text().startswith("epoch\thours")
+
+
+class TestStepTimer:
+    def test_warmup_excluded(self):
+        st = StepTimer(warmup=1)
+        for i in range(3):
+            with st.step():
+                time.sleep(0.02 if i == 0 else 0.005)
+        assert len(st.steady) == 2
+        assert st.mean_sec < 0.02
+        assert st.throughput(10) > 0
+
+    def test_sync_on_blocks_device_value(self):
+        st = StepTimer(warmup=0)
+        x = jnp.arange(1024.0)
+        with st.step():
+            y = (x * 2).sum()
+            st.sync_on(y)
+        assert st.mean_sec >= 0
+
+
+class TestWireMetrics:
+    def test_none_compressor_is_identity_cost(self):
+        x = jnp.zeros((128,), jnp.float32)
+        assert payload_nbytes(C.NoneCompressor(), x) == 128 * 4
+
+    def test_topk_payload_scales_with_ratio(self):
+        x = jnp.zeros((1000,), jnp.float32)
+        b = payload_nbytes(C.TopKCompressor(compress_ratio=0.01), x)
+        # 10 values (f32) + 10 indices (i32) = 80 bytes
+        assert b == 80
+
+    def test_signsgd_saves_bandwidth(self):
+        x = jnp.zeros((1024,), jnp.float32)
+        b = payload_nbytes(C.SignSGDCompressor(), x)
+        assert b < 1024 * 4
+
+    def test_wire_report_over_tree(self):
+        tree = {"w": jnp.zeros((100, 10)), "b": jnp.zeros((10,))}
+        rep = wire_report(C.TopKCompressor(compress_ratio=0.1), tree)
+        assert rep.dense_bytes == (1000 + 10) * 4
+        assert len(rep.leaves) == 2
+        assert 0 < rep.ratio < 1
+        assert "ratio" in rep.summary()
+        assert "CompressionReport" in str(rep)
+
+    def test_randomk_values_only(self):
+        # RandomK sends values only (indices derived from shared seed,
+        # reference grace_dl/dist/compressor/randomk.py:26-29).
+        x = jnp.zeros((1000,), jnp.float32)
+        b = payload_nbytes(C.RandomKCompressor(compress_ratio=0.01), x)
+        assert b == 10 * 4
