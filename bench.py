@@ -41,7 +41,7 @@ def _build_step(grace_params, mesh, num_classes, sgd_lr=1e-3):
     step = make_stateful_train_step(loss_fn, optimizer, mesh)
     params, mstate = resnet.init(jax.random.key(0), depth=50,
                                  num_classes=num_classes)
-    ts = init_stateful_train_state(params, mstate, optimizer)
+    ts = init_stateful_train_state(params, mstate, optimizer, mesh)
     return step, ts
 
 
@@ -56,7 +56,7 @@ def _throughput(step, ts, batch, n_batches, warmup=2):
 
 
 def main():
-    from grace_tpu.parallel import batch_sharded, data_parallel_mesh, replicated
+    from grace_tpu.parallel import batch_sharded, data_parallel_mesh
 
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
@@ -78,7 +78,6 @@ def main():
 
     def run(grace_params):
         step, ts = _build_step(grace_params, mesh, num_classes)
-        ts = jax.device_put(ts, replicated(mesh))
         return _throughput(step, ts, batch, n_batches)
 
     baseline = run({"compressor": "none", "memory": "none",

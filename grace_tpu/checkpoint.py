@@ -1,0 +1,118 @@
+"""Checkpoint/resume with orbax — including compression state.
+
+The reference checkpoints only model variables, via per-framework example
+code (tf.train.Checkpoint on rank 0, tensorflow2_mnist.py:96-99; Keras
+ModelCheckpoint; nothing at all for torch), and **never checkpoints
+compression state** — residual memories, PowerSGD's Q factor and Signum
+momentum silently reset on resume, losing accumulated error feedback
+(SURVEY.md §5, checkpoint row). grace-tpu closes that gap by construction:
+`GraceState` is a plain-array pytree inside the optimizer state, so the whole
+`TrainState`/`StatefulTrainState` (params + model state + optimizer state
+including every residual buffer) round-trips through one orbax save.
+
+Multi-host: orbax coordinates across processes internally (each process
+writes its addressable shards); there is no rank-0-only guard to write by
+hand, unlike the reference's ``if hvd.rank()==0`` idiom.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+__all__ = ["Checkpointer", "save_checkpoint", "restore_checkpoint",
+           "latest_step"]
+
+
+class Checkpointer:
+    """Thin wrapper over ``ocp.CheckpointManager`` for train states.
+
+    Usage::
+
+        ckpt = Checkpointer(dir, max_to_keep=3)
+        ckpt.save(step, state)                  # async; returns immediately
+        state = ckpt.restore(abstract_state)    # latest, or step=N
+        ckpt.close()                            # wait for pending writes
+    """
+
+    def __init__(self, directory: str | os.PathLike,
+                 max_to_keep: Optional[int] = 3,
+                 save_interval_steps: int = 1):
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps)
+        self._mgr = ocp.CheckpointManager(os.path.abspath(str(directory)),
+                                          options=options)
+
+    @property
+    def directory(self) -> str:
+        return str(self._mgr.directory)
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Save ``state`` (any pytree of arrays/scalars) at ``step``."""
+        return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                              force=force)
+
+    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure of ``target``.
+
+        ``target`` may be a concrete state (its arrays give shape/dtype/
+        sharding) or an abstract one built with ``jax.eval_shape``. Restores
+        the latest step when ``step`` is None.
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self.directory}")
+        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                          target)
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def wait(self) -> None:
+        """Block until async saves complete."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def save_checkpoint(directory: str | os.PathLike, state: Any,
+                    step: int) -> None:
+    """One-shot synchronous save (convenience for scripts/tests)."""
+    with Checkpointer(directory, max_to_keep=None) as ckpt:
+        ckpt.save(step, state, force=True)
+
+
+def restore_checkpoint(directory: str | os.PathLike, target: Any,
+                       step: Optional[int] = None) -> Any:
+    """One-shot restore of the latest (or given) step into ``target``'s shape."""
+    if not os.path.isdir(directory):
+        # Don't let CheckpointManager create directories on a read path.
+        raise FileNotFoundError(f"no checkpoint directory at {directory}")
+    with Checkpointer(directory) as ckpt:
+        return ckpt.restore(target, step=step)
+
+
+def latest_step(directory: str | os.PathLike) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    with Checkpointer(directory) as ckpt:
+        return ckpt.latest_step()

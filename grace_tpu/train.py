@@ -9,20 +9,28 @@ one jitted SPMD train step: per-device gradients are computed inside
 the compression collectives with remaining backward compute — the async
 send/receive split of the torch backend (grace_dl/torch/__init__.py:37-58)
 falls out of the compiler for free.
+
+State layout: params / model state / non-grace optimizer state are
+replicated; GraceState mem/comp leaves (per-rank residuals/momenta, see
+grace_tpu/transform.py) carry a leading world axis sharded over the mesh.
+Always build states with :func:`init_train_state` /
+:func:`init_stateful_train_state` (passing the mesh) so the layout matches
+what the step functions expect.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
-import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from grace_tpu.core import DEFAULT_AXIS
+from grace_tpu.parallel import replicated
+from grace_tpu.transform import (add_world_axis, partition_specs,
+                                 strip_world_axis)
 
 __all__ = ["TrainState", "StatefulTrainState", "make_train_step",
            "make_stateful_train_step", "make_eval_step",
@@ -32,6 +40,35 @@ __all__ = ["TrainState", "StatefulTrainState", "make_train_step",
 class TrainState(NamedTuple):
     params: Any
     opt_state: Any
+
+
+class StatefulTrainState(NamedTuple):
+    params: Any
+    model_state: Any   # e.g. BatchNorm running stats
+    opt_state: Any
+
+
+def _lazy_sharded_step(device_step, mesh: Mesh, axis_name: str, donate: bool):
+    """jit(shard_map(device_step)) with state specs derived from the first
+    state actually passed in — the spec pytree depends on where GraceState
+    nodes sit inside the (optimizer-dependent) state structure."""
+    cache = {}
+
+    def step(state, batch):
+        key = jax.tree_util.tree_structure(state)
+        fn = cache.get(key)
+        if fn is None:
+            specs = partition_specs(state, axis_name)
+            sharded = jax.shard_map(
+                device_step, mesh=mesh,
+                in_specs=(specs, P(axis_name)),
+                out_specs=(specs, P()),
+                check_vma=False)
+            fn = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+            cache[key] = fn
+        return fn(state, batch)
+
+    return step
 
 
 def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
@@ -51,27 +88,14 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
     """
 
     def device_step(state: TrainState, batch):
+        opt_state = strip_world_axis(state.opt_state)
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
+        updates, opt_state = optimizer.update(grads, opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         loss = lax.pmean(loss, axis_name)
-        return TrainState(params, opt_state), loss
+        return TrainState(params, add_world_axis(opt_state)), loss
 
-    sharded = jax.shard_map(
-        device_step, mesh=mesh,
-        in_specs=(P(), P(axis_name)),
-        out_specs=(P(), P()),
-        check_vma=False)
-
-    donate_argnums = (0,) if donate else ()
-    return jax.jit(sharded, donate_argnums=donate_argnums)
-
-
-class StatefulTrainState(NamedTuple):
-    params: Any
-    model_state: Any   # e.g. BatchNorm running stats
-    opt_state: Any
+    return _lazy_sharded_step(device_step, mesh, axis_name, donate)
 
 
 def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
@@ -91,31 +115,48 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
     """
 
     def device_step(state: StatefulTrainState, batch):
+        opt_state = strip_world_axis(state.opt_state)
         (loss, mstate), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.model_state, batch)
         if sync_model_state:
             mstate = jax.tree_util.tree_map(
                 lambda m: lax.pmean(m, axis_name), mstate)
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
+        updates, opt_state = optimizer.update(grads, opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         loss = lax.pmean(loss, axis_name)
-        return StatefulTrainState(params, mstate, opt_state), loss
+        return (StatefulTrainState(params, mstate, add_world_axis(opt_state)),
+                loss)
 
-    sharded = jax.shard_map(
-        device_step, mesh=mesh,
-        in_specs=(P(), P(axis_name)),
-        out_specs=(P(), P()),
-        check_vma=False)
-    donate_argnums = (0,) if donate else ()
-    return jax.jit(sharded, donate_argnums=donate_argnums)
+    return _lazy_sharded_step(device_step, mesh, axis_name, donate)
+
+
+def _init_opt_state(params: Any, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, axis_name: str) -> Any:
+    """Optimizer state in the global layout: grace mem/comp leaves get their
+    leading world axis, sharded over ``axis_name``; the rest is replicated."""
+    abstract = jax.eval_shape(optimizer.init, params)
+    specs = partition_specs(abstract, axis_name)
+    init_fn = jax.shard_map(
+        lambda p: add_world_axis(optimizer.init(p)),
+        mesh=mesh, in_specs=(P(),), out_specs=specs, check_vma=False)
+    return jax.jit(init_fn)(params)
+
+
+def init_train_state(params: Any, optimizer: optax.GradientTransformation,
+                     mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> TrainState:
+    return TrainState(
+        params=jax.device_put(params, replicated(mesh)),
+        opt_state=_init_opt_state(params, optimizer, mesh, axis_name))
 
 
 def init_stateful_train_state(params: Any, model_state: Any,
-                              optimizer: optax.GradientTransformation
+                              optimizer: optax.GradientTransformation,
+                              mesh: Mesh, axis_name: str = DEFAULT_AXIS
                               ) -> StatefulTrainState:
-    return StatefulTrainState(params=params, model_state=model_state,
-                              opt_state=optimizer.init(params))
+    return StatefulTrainState(
+        params=jax.device_put(params, replicated(mesh)),
+        model_state=jax.device_put(model_state, replicated(mesh)),
+        opt_state=_init_opt_state(params, optimizer, mesh, axis_name))
 
 
 def make_eval_step(metric_fn: Callable[[Any, Any], Any], mesh: Mesh,
@@ -137,8 +178,3 @@ def make_eval_step(metric_fn: Callable[[Any, Any], Any], mesh: Mesh,
         out_specs=P(),
         check_vma=False)
     return jax.jit(sharded)
-
-
-def init_train_state(params: Any, optimizer: optax.GradientTransformation
-                     ) -> TrainState:
-    return TrainState(params=params, opt_state=optimizer.init(params))

@@ -22,6 +22,18 @@ replicated across ranks, so per-(step, leaf) keys derived via ``fold_in`` are
 rank-identical — the explicit contract RandomK/PowerSGD rely on (the
 reference relied on global-seed side effects, grace_dl/dist/compressor/
 randomk.py:26-29).
+
+**Memory/compressor state is per-rank data** — each worker accumulates its
+own residual, exactly as the reference's per-process dicts do
+(grace_dl/dist/memory/residual.py:6-20). In the global (outside-shard_map)
+view, every ``mem``/``comp`` leaf therefore carries a leading world axis
+sharded over the mesh: global shape ``(world, *leaf_shape)``, one row per
+rank. ``add_world_axis``/``strip_world_axis`` convert between that layout
+and the per-device view used inside the transform, and
+``partition_specs`` produces the matching `PartitionSpec` pytree
+(``P(axis)`` for mem/comp leaves, ``P()`` for everything else). This makes
+residual state an honest sharded array — checkpoints capture every rank's
+error feedback, not whichever replica the host happened to read.
 """
 
 from __future__ import annotations
@@ -36,10 +48,69 @@ from grace_tpu.core import Communicator, Compressor, Memory, State
 
 
 class GraceState(NamedTuple):
-    count: jax.Array          # step counter
+    count: jax.Array          # step counter (replicated)
     rng_key: jax.Array        # replicated base key, stored as raw key data
     mem: Tuple[State, ...]    # per-leaf memory state, leaf order of tree_flatten
     comp: Tuple[State, ...]   # per-leaf compressor state
+
+
+def _is_grace(x) -> bool:
+    return isinstance(x, GraceState)
+
+
+def _map_grace_varying(fn, tree):
+    """Apply ``fn`` to the device-varying leaves (mem/comp) of every
+    GraceState embedded in ``tree``; leave all other leaves untouched."""
+
+    def per_node(node):
+        if _is_grace(node):
+            return GraceState(node.count, node.rng_key,
+                              jax.tree_util.tree_map(fn, node.mem),
+                              jax.tree_util.tree_map(fn, node.comp))
+        return node
+
+    return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
+
+
+def add_world_axis(tree):
+    """Per-device → global layout: prepend a (local size 1) world axis to
+    every mem/comp leaf. Call on values produced inside shard_map."""
+    return _map_grace_varying(lambda x: x[None], tree)
+
+
+def strip_world_axis(tree):
+    """Global → per-device layout: drop this rank's world axis (local shards
+    have leading dim 1 inside shard_map)."""
+
+    def strip(x):
+        if jnp.ndim(x) < 1 or x.shape[0] != 1:
+            raise ValueError(
+                "grace mem/comp state leaf has no leading world axis "
+                f"(local shape {jnp.shape(x)}). Build training states with "
+                "init_train_state/init_stateful_train_state(params, optimizer"
+                ", mesh) — states built as optimizer.init(params) lack the "
+                "sharded world axis and would be silently mis-sharded.")
+        return x[0]
+
+    return _map_grace_varying(strip, tree)
+
+
+def partition_specs(tree, axis_name: str):
+    """PartitionSpec pytree for a state pytree containing GraceState nodes:
+    mem/comp leaves shard their leading world axis over ``axis_name``;
+    everything else is replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def per_node(node):
+        if _is_grace(node):
+            return GraceState(
+                jax.tree_util.tree_map(lambda _: P(), node.count),
+                jax.tree_util.tree_map(lambda _: P(), node.rng_key),
+                jax.tree_util.tree_map(lambda _: P(axis_name), node.mem),
+                jax.tree_util.tree_map(lambda _: P(axis_name), node.comp))
+        return jax.tree_util.tree_map(lambda _: P(), node)
+
+    return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
 
 
 def grace_transform(compressor: Compressor, memory: Memory,

@@ -14,7 +14,7 @@ import pytest
 
 from grace_tpu import grace_from_params
 from grace_tpu.models import lenet, resnet, resnet_cifar, transformer
-from grace_tpu.parallel import batch_sharded, replicated
+from grace_tpu.parallel import batch_sharded
 from grace_tpu.train import (init_stateful_train_state,
                              make_stateful_train_step)
 
@@ -100,8 +100,7 @@ def test_end_to_end_compressed_training(mesh, grace_params):
         return loss.mean(), new_mstate
 
     step = make_stateful_train_step(loss_fn, optimizer, mesh)
-    ts = jax.device_put(init_stateful_train_state(params, mstate, optimizer),
-                        replicated(mesh))
+    ts = init_stateful_train_state(params, mstate, optimizer, mesh)
 
     ts, first = step(ts, batch)
     for _ in range(30):

@@ -13,7 +13,7 @@ import pytest
 
 import grace_tpu
 from grace_tpu import grace_from_params
-from grace_tpu.train import TrainState, make_train_step
+from grace_tpu.train import init_train_state, make_train_step
 
 BATCH, DIM, CLASSES = 64, 20, 4
 
@@ -42,7 +42,7 @@ def train(mesh, grace_params, steps=60, lr=0.3, seed=0):
     grc = grace_from_params(grace_params)
     tx = optax.chain(grc.transform(seed=1), optax.sgd(lr))
     params = init_params(rng)
-    state = TrainState(params, tx.init(params))
+    state = init_train_state(params, tx, mesh)
     step = make_train_step(loss_fn, tx, mesh, donate=False)
     losses = []
     for _ in range(steps):
@@ -116,7 +116,7 @@ def test_grace_state_checkpointable(mesh):
                              "memory": "residual", "communicator": "allgather"})
     tx = optax.chain(grc.transform(), optax.sgd(0.1))
     params = init_params(rng)
-    state = TrainState(params, tx.init(params))
+    state = init_train_state(params, tx, mesh)
     step = make_train_step(loss_fn, tx, mesh, donate=False)
     x, y = make_problem(rng)
     state, _ = step(state, (x, y))
@@ -126,3 +126,20 @@ def test_grace_state_checkpointable(mesh):
     state2, l2 = step(jax.tree_util.tree_map(jnp.asarray, restored), (x, y))
     state1, l1 = step(state, (x, y))
     assert np.isclose(float(l1), float(l2))
+
+
+def test_old_style_state_rejected(mesh):
+    """States built without the world axis must fail loudly, not mis-shard."""
+    rng = np.random.default_rng(0)
+    grc = grace_from_params({"compressor": "topk", "compress_ratio": 0.3,
+                             "memory": "residual", "communicator": "allgather"})
+    tx = optax.chain(grc.transform(), optax.sgd(0.1))
+    params = init_params(rng)
+    from grace_tpu.train import TrainState
+    bad = TrainState(params, tx.init(params))  # missing world axis
+    step = make_train_step(loss_fn, tx, mesh, donate=False)
+    x, y = make_problem(rng)
+    # Either our explicit guard fires (divisible shapes) or shard_map's
+    # divisibility check does — both are loud ValueErrors, never silence.
+    with pytest.raises(ValueError, match="world axis|evenly divisible"):
+        step(bad, (x, y))
