@@ -52,7 +52,9 @@ def _throughput(step, ts, batch, n_batches, warmup=2):
         with timer.step():
             ts, loss = step(ts, batch)
             timer.sync_on(loss)
-    return timer.throughput(items_per_step=batch[1].shape[0])
+    # Return the final state too: the step donates its input buffers, so
+    # callers must thread the live state into any further timed runs.
+    return timer.throughput(items_per_step=batch[1].shape[0]), ts
 
 
 def main():
@@ -66,7 +68,8 @@ def main():
     # CPU fallback shrinks shapes so the bench stays runnable anywhere.
     per_device_bs = 32 if on_tpu else 4
     image_hw = 224 if on_tpu else 64
-    n_batches = 20 if on_tpu else 3
+    n_batches = 30 if on_tpu else 3
+    repeats = 2 if on_tpu else 1
     num_classes = 1000
 
     n = per_device_bs * len(devices)
@@ -77,13 +80,21 @@ def main():
     batch = jax.device_put((x, y), batch_sharded(mesh))
 
     def run(grace_params):
+        # best-of-N to damp chip/host jitter (~8% run-to-run on the tunnel)
         step, ts = _build_step(grace_params, mesh, num_classes)
-        return _throughput(step, ts, batch, n_batches)
+        best = 0.0
+        for _ in range(repeats):
+            tput, ts = _throughput(step, ts, batch, n_batches, warmup=4)
+            best = max(best, tput)
+        return best
 
+    # Both sides get the fusion buffer — Horovod fuses the uncompressed
+    # baseline too, so a like-for-like ratio must as well.
     baseline = run({"compressor": "none", "memory": "none",
-                    "communicator": "allreduce"})
+                    "communicator": "allreduce", "fusion": "flat"})
     compressed = run({"compressor": "topk", "compress_ratio": 0.01,
-                      "memory": "residual", "communicator": "allgather"})
+                      "memory": "residual", "communicator": "allgather",
+                      "fusion": "flat"})
 
     print(json.dumps({
         "metric": "resnet50_topk1pct_imgs_per_sec",

@@ -36,10 +36,12 @@ class Grace:
     compressor: Compressor
     memory: Memory
     communicator: Communicator
+    fusion: Any = None   # None | 'flat' | bucket bytes (see grace_transform)
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,
-                               self.communicator, seed=seed)
+                               self.communicator, seed=seed,
+                               fusion=self.fusion)
 
 
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
@@ -118,8 +120,14 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
 
 
 def grace_from_params(params: Dict[str, Any]) -> Grace:
-    """Configure the triad from the reference's params-dict schema."""
+    """Configure the triad from the reference's params-dict schema.
+
+    ``fusion`` (None | 'flat' | int bytes) is a grace-tpu extension with no
+    reference analog in the params dict — Horovod's fusion buffer was a
+    buried env knob (HOROVOD_FUSION_THRESHOLD); here it is first-class.
+    """
     axis = params.get("axis_name", DEFAULT_AXIS)
     return Grace(compressor=_build_compressor(params, axis),
                  memory=_build_memory(params, axis),
-                 communicator=_build_communicator(params, axis))
+                 communicator=_build_communicator(params, axis),
+                 fusion=params.get("fusion"))

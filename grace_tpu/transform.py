@@ -42,6 +42,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from grace_tpu.core import Communicator, Compressor, Memory, State
@@ -113,20 +114,81 @@ def partition_specs(tree, axis_name: str):
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
 
 
+def _bucketize(shapes_dtypes, bucket_bytes: Optional[int]):
+    """Group leaf indices into fusion buckets of at most ``bucket_bytes``
+    (whole leaves only; an oversized leaf gets its own bucket). ``None``
+    means one bucket for everything. Deterministic in leaf order, so init
+    and update always agree. Returns (buckets, common_dtype)."""
+    n = len(shapes_dtypes)
+    cdtype = jnp.result_type(*(d for _, d in shapes_dtypes)) \
+        if shapes_dtypes else jnp.float32
+    if bucket_bytes is None:
+        return [list(range(n))], cdtype
+    itemsize = jnp.dtype(cdtype).itemsize
+    buckets, cur, cur_bytes = [], [], 0
+    for i, (shape, _) in enumerate(shapes_dtypes):
+        nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets, cdtype
+
+
 def grace_transform(compressor: Compressor, memory: Memory,
-                    communicator: Communicator, seed: int = 0
+                    communicator: Communicator, seed: int = 0,
+                    fusion: Optional[int | str] = None
                     ) -> optax.GradientTransformation:
     """Build the compressed-exchange transformation.
 
     The returned transform maps *local* (per-device) gradients to globally
     aggregated ones, exactly like ``Communicator.step`` in the reference
     (grace_dl/dist/__init__.py:47-52) but over whole pytrees.
+
+    ``fusion`` is the TPU-native analog of Horovod's C++ fusion buffer
+    (SURVEY.md §2.4: the reference inherits tensor fusion from Horovod's
+    background coordinator; the dist backend has none and pays one NCCL call
+    per tensor, SURVEY.md §3.3). Options:
+
+    * ``None`` — per-leaf pipeline: one compress+collective per parameter,
+      matching the reference's per-tensor semantics exactly (Top-K ratio
+      applied per tensor, etc.).
+    * ``'flat'`` — concatenate every gradient into ONE flat buffer: one
+      compress + one collective for the whole model. Fewer, larger
+      collectives ride ICI far better; selection-based compressors then pick
+      k over the whole model (cross-tensor Top-K — slightly different but
+      generally *stronger* selection than per-tensor).
+    * ``int`` — greedy whole-leaf buckets of at most this many bytes
+      (Horovod's default fusion threshold is 64 MiB).
+
+    Leaves are cast to their common result dtype inside a fused buffer and
+    cast back on return.
     """
+    if isinstance(fusion, str) and fusion != "flat":
+        raise ValueError(f"fusion must be None, 'flat', or int bytes; "
+                         f"got {fusion!r}")
+    bucket_bytes = None if fusion == "flat" else fusion
+    fused = fusion is not None
+
+    def _bucket_views(leaves):
+        """Static bucketing plan for these leaves: (buckets, common dtype)."""
+        return _bucketize([(jnp.shape(l), jnp.result_type(l))
+                           for l in leaves], bucket_bytes)
 
     def init(params) -> GraceState:
         leaves = jax.tree_util.tree_leaves(params)
-        mem = tuple(memory.init_state(p) for p in leaves)
-        comp = tuple(compressor.init_state(p) for p in leaves)
+        if fused:
+            buckets, cdtype = _bucket_views(leaves)
+            flats = [jnp.concatenate([jnp.ravel(leaves[i]).astype(cdtype)
+                                      for i in idxs]) for idxs in buckets]
+            mem = tuple(memory.init_state(f) for f in flats)
+            comp = tuple(compressor.init_state(f) for f in flats)
+        else:
+            mem = tuple(memory.init_state(p) for p in leaves)
+            comp = tuple(compressor.init_state(p) for p in leaves)
         # Raw key data (uint32) instead of a typed key array so the whole
         # state is plain-array checkpointable with any writer.
         return GraceState(count=jnp.zeros((), jnp.int32),
@@ -138,14 +200,36 @@ def grace_transform(compressor: Compressor, memory: Memory,
         leaves, treedef = jax.tree_util.tree_flatten(updates)
         base_key = jax.random.wrap_key_data(state.rng_key)
         step_key = jax.random.fold_in(base_key, state.count)
-        outs, new_mem, new_comp = [], [], []
-        for i, (g, ms, cs) in enumerate(zip(leaves, state.mem, state.comp,
-                                            strict=True)):
-            rng = jax.random.fold_in(step_key, i)
-            out, ms, cs = communicator.step(g, ms, cs, memory, compressor, rng)
-            outs.append(out)
-            new_mem.append(ms)
-            new_comp.append(cs)
+        new_mem, new_comp = [], []
+        if fused:
+            buckets, cdtype = _bucket_views(leaves)
+            outs = [None] * len(leaves)
+            for b, idxs in enumerate(buckets):
+                rng = jax.random.fold_in(step_key, b)
+                flat = jnp.concatenate([jnp.ravel(leaves[i]).astype(cdtype)
+                                        for i in idxs])
+                out, ms, cs = communicator.step(
+                    flat, state.mem[b], state.comp[b], memory, compressor, rng)
+                off = 0
+                for i in idxs:
+                    shape = jnp.shape(leaves[i])
+                    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                    piece = out[off:off + size]
+                    outs[i] = piece.reshape(shape).astype(
+                        jnp.result_type(leaves[i]))
+                    off += size
+                new_mem.append(ms)
+                new_comp.append(cs)
+        else:
+            outs = []
+            for i, (g, ms, cs) in enumerate(zip(leaves, state.mem, state.comp,
+                                                strict=True)):
+                rng = jax.random.fold_in(step_key, i)
+                out, ms, cs = communicator.step(g, ms, cs, memory, compressor,
+                                                rng)
+                outs.append(out)
+                new_mem.append(ms)
+                new_comp.append(cs)
         new_state = GraceState(count=state.count + 1, rng_key=state.rng_key,
                                mem=tuple(new_mem), comp=tuple(new_comp))
         return jax.tree_util.tree_unflatten(treedef, outs), new_state

@@ -1,0 +1,141 @@
+"""Fusion-buffer tests: bucketed/flat gradient exchange.
+
+The Horovod fusion buffer (SURVEY.md §2.4) is opaque C++; here fusion is an
+explicit, testable transform option. Key properties: exactness for linear
+codecs, convergence for sparsifiers, bucketing plan correctness, and dtype
+round-trip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from grace_tpu import grace_from_params
+from grace_tpu.train import init_train_state, make_train_step
+from grace_tpu.transform import _bucketize
+
+
+class TestBucketize:
+    def test_flat_is_one_bucket(self):
+        buckets, _ = _bucketize([((10,), jnp.float32), ((5, 5), jnp.float32)],
+                                None)
+        assert buckets == [[0, 1]]
+
+    def test_byte_limit_splits(self):
+        specs = [((100,), jnp.float32)] * 3  # 400B each
+        buckets, _ = _bucketize(specs, 500)
+        assert buckets == [[0], [1], [2]]
+        buckets, _ = _bucketize(specs, 800)
+        assert buckets == [[0, 1], [2]]
+
+    def test_oversized_leaf_own_bucket(self):
+        specs = [((10,), jnp.float32), ((1000,), jnp.float32),
+                 ((10,), jnp.float32)]
+        buckets, _ = _bucketize(specs, 100)
+        assert buckets == [[0], [1], [2]]
+
+    def test_common_dtype_promotion(self):
+        _, dt = _bucketize([((4,), jnp.bfloat16), ((4,), jnp.float32)], None)
+        assert dt == jnp.float32
+
+
+def _make_problem(rng, n=64):
+    x = rng.standard_normal((n * 8, 12)).astype(np.float32)
+    w = rng.standard_normal((12, 3)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    logits = x @ params["w"] + params["b"]
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _params(rng):
+    return {"w": jnp.asarray(rng.standard_normal((12, 3)).astype(np.float32)
+                             * 0.1),
+            "b": jnp.zeros((3,), jnp.float32)}
+
+
+def _train(mesh, cfg, steps=30, lr=0.2, seed=0):
+    rng = np.random.default_rng(seed)
+    batch = _make_problem(rng)
+    grc = grace_from_params(cfg)
+    tx = optax.chain(grc.transform(seed=1), optax.sgd(lr))
+    state = init_train_state(_params(rng), tx, mesh)
+    step = make_train_step(_loss_fn, tx, mesh, donate=False)
+    losses = []
+    for _ in range(steps):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    return losses, state
+
+
+class TestFusedTraining:
+    def test_flat_none_matches_per_leaf_exactly(self, mesh):
+        """Uncompressed exchange is linear: fused == per-leaf bit-for-bit."""
+        base_cfg = {"compressor": "none", "memory": "none",
+                    "communicator": "allreduce"}
+        l0, s0 = _train(mesh, base_cfg, steps=5)
+        l1, s1 = _train(mesh, {**base_cfg, "fusion": "flat"}, steps=5)
+        np.testing.assert_allclose(np.asarray(s0.params["w"]),
+                                   np.asarray(s1.params["w"]), rtol=1e-6)
+        np.testing.assert_allclose(l0, l1, rtol=1e-6)
+
+    @pytest.mark.parametrize("fusion", ["flat", 256])
+    def test_topk_fused_converges(self, mesh, fusion):
+        losses, _ = _train(mesh, {"compressor": "topk", "compress_ratio": 0.3,
+                                  "memory": "residual",
+                                  "communicator": "allgather",
+                                  "fusion": fusion}, steps=40)
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+    def test_qsgd_fused_converges(self, mesh):
+        losses, _ = _train(mesh, {"compressor": "qsgd", "quantum_num": 64,
+                                  "memory": "none",
+                                  "communicator": "allgather",
+                                  "fusion": "flat"}, steps=40)
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_fused_state_is_per_bucket(self, mesh):
+        _, state = _train(mesh, {"compressor": "topk", "compress_ratio": 0.3,
+                                 "memory": "residual",
+                                 "communicator": "allgather",
+                                 "fusion": "flat"}, steps=3)
+        grace_state = state.opt_state[0]
+        assert len(grace_state.mem) == 1  # one bucket -> one residual buffer
+        # world axis: 8 ranks x 39 fused elements (12*3 + 3)
+        assert grace_state.mem[0].shape == (8, 39)
+
+    def test_mixed_dtype_roundtrip(self, mesh):
+        rng = np.random.default_rng(0)
+        grc = grace_from_params({"compressor": "none", "memory": "none",
+                                 "communicator": "allreduce",
+                                 "fusion": "flat"})
+        tx = optax.chain(grc.transform(), optax.sgd(0.1))
+        params = {"w": jnp.zeros((4, 3), jnp.bfloat16),
+                  "b": jnp.zeros((3,), jnp.float32)}
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return jnp.mean((x.astype(jnp.bfloat16) @ p["w"]).astype(
+                jnp.float32) + p["b"] - y) ** 2
+
+        state = init_train_state(params, tx, mesh)
+        step = make_train_step(loss_fn, tx, mesh, donate=False)
+        x = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 3)), jnp.float32)
+        state, loss = step(state, (x, y))
+        assert state.params["w"].dtype == jnp.bfloat16
+        assert state.params["b"].dtype == jnp.float32
+        assert jnp.isfinite(loss)
+
+    def test_invalid_fusion_rejected(self):
+        grc = grace_from_params({"compressor": "none", "memory": "none",
+                                 "communicator": "allreduce",
+                                 "fusion": "banana"})
+        with pytest.raises(ValueError, match="fusion"):
+            grc.transform()
