@@ -1,0 +1,22 @@
+"""Frontend interop: run the TPU grace pipeline under foreign frameworks.
+
+Replaces the reference's entire Horovod patch surface (SURVEY.md §2.7): where
+GRACE ships a 507-line patch against Horovod 0.18.2 that threads a `grace`
+object through every gradient code path, grace-tpu needs no patch — the
+compressed exchange is a jitted JAX program, and frontends hand it their
+gradients through a narrow numpy bridge:
+
+* :class:`~grace_tpu.interop.bridge.GraceBridge` — framework-agnostic core:
+  one flat gradient buffer in, aggregated buffer out, compression state held
+  on device between steps.
+* :mod:`grace_tpu.interop.torch` — ``DistributedOptimizer`` with the
+  reference's API and safety semantics (hooks, ``backward_passes_per_step``,
+  ``skip_synchronize``, ``zero_grad`` guard), plus
+  ``broadcast_parameters`` / ``broadcast_optimizer_state``.
+* :mod:`grace_tpu.interop.tensorflow` — ``DistributedGradientTape`` analog
+  (import-gated; TF is optional).
+"""
+
+from grace_tpu.interop.bridge import GraceBridge
+
+__all__ = ["GraceBridge"]

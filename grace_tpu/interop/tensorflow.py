@@ -1,0 +1,78 @@
+"""TensorFlow 2 frontend: DistributedGradientTape over the TPU pipeline.
+
+Analog of the reference's patched `hvd.DistributedGradientTape(tape, grace)`
+(patch_files/horovod/tensorflow/__init__.py:314-365): wrap a `tf.GradientTape`
+so `tape.gradient(...)` returns globally aggregated, compressed-exchanged
+gradients. The mechanism is the same numpy bridge as the torch frontend —
+TF is an optional dependency (import-gated; this image ships without it).
+
+Note the execution model difference from the reference: the TF2 patch runs
+GRACE ops *inside* the TF graph (SURVEY.md §3.2); here the exchange runs in
+JAX/XLA on the TPU mesh and the TF side only sees numpy values, so this
+wrapper must be used in eager mode (no @tf.function around the exchange).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from grace_tpu.helper import Grace
+
+__all__ = ["DistributedGradientTape"]
+
+
+def DistributedGradientTape(gradtape, grace: Grace, mesh=None, seed: int = 0):
+    """Wrap ``tf.GradientTape`` so ``gradient()`` returns aggregated grads."""
+    try:
+        import tensorflow as tf  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "grace_tpu.interop.tensorflow requires the optional tensorflow "
+            "dependency, which is not installed in this environment."
+        ) from e
+
+    from grace_tpu.interop.bridge import GraceBridge
+
+    class _Wrapped(type(gradtape)):
+        def __init__(self):
+            self.__dict__.update(gradtape.__dict__)
+            self._grace = grace
+            self._bridge = None
+            self._mesh = mesh
+            self._seed = seed
+
+        def gradient(self, target, sources, output_gradients=None):
+            # tf.GradientTape.gradient mirrors the structure of `sources`:
+            # a lone tensor source yields a lone gradient, not a list.
+            single = not isinstance(sources, (list, tuple))
+            grads = super().gradient(target, sources, output_gradients)
+            if single:
+                grads = [grads]
+            flats, shapes, sizes, dtypes = [], [], [], []
+            for g in grads:
+                arr = np.zeros(0, np.float32) if g is None else \
+                    np.asarray(tf.convert_to_tensor(g), np.float32).ravel()
+                flats.append(arr)
+                shapes.append(None if g is None else tuple(g.shape))
+                dtypes.append(None if g is None else g.dtype)
+                sizes.append(arr.size)
+            flat = np.concatenate(flats) if flats else np.zeros(0, np.float32)
+            if self._bridge is None:
+                self._bridge = GraceBridge(self._grace, n=flat.size,
+                                           mesh=self._mesh, seed=self._seed)
+            out = np.asarray(self._bridge.exchange(flat))
+            results, off = [], 0
+            for shape, size, dtype in zip(shapes, sizes, dtypes):
+                if shape is None:
+                    results.append(None)
+                else:
+                    results.append(tf.constant(
+                        out[off:off + size].reshape(shape), dtype=dtype))
+                off += size
+            return results[0] if single else results
+
+    wrapped = _Wrapped.__new__(_Wrapped)
+    _Wrapped.__init__(wrapped)
+    return wrapped

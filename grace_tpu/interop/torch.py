@@ -1,0 +1,258 @@
+"""PyTorch frontend: DistributedOptimizer over the TPU grace pipeline.
+
+API and safety semantics mirror the reference's patched Horovod optimizer
+(patch_files/horovod/torch/__init__.py:46-250) — same constructor shape,
+``named_parameters`` validation, ``backward_passes_per_step`` gradient
+accumulation, ``synchronize``/``skip_synchronize`` protocol, ``zero_grad``
+race guard — but the mechanism is TPU-native: instead of one async NCCL op
+per parameter launched from per-parameter hooks, all gradients are fused
+into one flat buffer and pushed through a single jitted XLA program
+(:class:`~grace_tpu.interop.bridge.GraceBridge`). The hook fired by the LAST
+ready gradient launches the exchange, so the XLA computation overlaps any
+remaining host-side work; ``synchronize()`` blocks on the result — the same
+send/receive split as grace_dl/torch/__init__.py:50-58, with one op instead
+of N.
+
+``broadcast_parameters`` / ``broadcast_optimizer_state`` replace the
+reference's init-time Horovod broadcasts
+(patch_files/horovod/torch/__init__.py:253-403) with
+`jax.experimental.multihost_utils.broadcast_one_to_all`.
+"""
+
+from __future__ import annotations
+
+import warnings
+from contextlib import contextmanager
+from typing import Iterable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from grace_tpu.helper import Grace
+
+__all__ = ["DistributedOptimizer", "broadcast_parameters",
+           "broadcast_optimizer_state"]
+
+
+def _find_duplicates(names):
+    seen, dups = set(), set()
+    for n in names:
+        if n in seen:
+            dups.add(n)
+        seen.add(n)
+    return dups
+
+
+class _DistributedOptimizer:
+    """Mixin injected over the user's optimizer class (dynamic subclass,
+    same trick as the reference factory, torch/__init__.py:245-250)."""
+
+    def _grace_init(self, named_parameters, grace: Grace, mesh, seed,
+                    backward_passes_per_step):
+        import torch  # local import: keep grace_tpu core torch-free
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [(f"grace.noname.{i}", v)
+                                for param_group in self.param_groups
+                                for i, v in enumerate(param_group["params"])]
+        if any(not isinstance(p, tuple) for p in named_parameters):
+            raise ValueError("named_parameters should be a sequence of "
+                             "tuples (name, parameter), usually produced by "
+                             "model.named_parameters().")
+        dups = _find_duplicates(k for k, _ in named_parameters)
+        if dups:
+            raise ValueError("Parameter names in named_parameters must be "
+                             "unique. Found duplicates: %s"
+                             % ", ".join(sorted(dups)))
+        all_ids = {id(v) for g in self.param_groups for v in g["params"]}
+        named_ids = {id(v) for _, v in named_parameters}
+        if all_ids - named_ids:
+            raise ValueError("named_parameters was specified, but one or "
+                             "more model parameters were not named.")
+
+        # Deterministic cross-process ordering: sort by name, exactly like
+        # the reference (torch/__init__.py:80-83).
+        self._grace_params = [p for _, p in sorted(named_parameters)
+                              if p.requires_grad]
+        self._param_names = {id(p): n for n, p in named_parameters}
+        self._sizes = [p.numel() for p in self._grace_params]
+        self._shapes = [tuple(p.shape) for p in self._grace_params]
+        n_total = sum(self._sizes)
+
+        from grace_tpu.interop.bridge import GraceBridge
+        self._bridge = GraceBridge(grace, n=n_total, mesh=mesh, seed=seed)
+
+        self.backward_passes_per_step = backward_passes_per_step
+        self._delay = {id(p): backward_passes_per_step
+                       for p in self._grace_params}
+        self._pending = None          # in-flight aggregated device array
+        self._synchronized = False
+        self._should_synchronize = True
+        self._hook_handles = [
+            p.register_post_accumulate_grad_hook(self._make_hook())
+            for p in self._grace_params]
+        self._torch = torch
+
+    # -- backward-path machinery -------------------------------------------
+    def _make_hook(self):
+        def hook(p):
+            if self._pending is not None:
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to step(). "
+                    "Increase backward_passes_per_step to accumulate "
+                    "gradients locally.")
+            assert self._delay[id(p)] > 0
+            self._delay[id(p)] -= 1
+            if all(d == 0 for d in self._delay.values()):
+                self._launch()
+        return hook
+
+    def _flat_grads(self) -> np.ndarray:
+        torch = self._torch
+        chunks = [
+            (p.grad if p.grad is not None
+             else torch.zeros_like(p)).detach().reshape(-1).to(torch.float32)
+            for p in self._grace_params]
+        return torch.cat(chunks).cpu().numpy()
+
+    def _launch(self):
+        """Start the fused exchange (async); called by the last grad hook."""
+        self._pending = self._bridge.exchange(self._flat_grads())
+
+    def synchronize(self):
+        """Block on the exchange and write aggregated grads back."""
+        if self._pending is None:
+            self._launch()   # e.g. manual use without full backward
+        # np.array (copy): torch.from_numpy needs a writable buffer, and the
+        # realized jax array is read-only.
+        out = np.array(self._pending)     # blocks on the XLA computation
+        self._pending = None
+        torch = self._torch
+        off = 0
+        for p, size, shape in zip(self._grace_params, self._sizes,
+                                  self._shapes):
+            piece = torch.from_numpy(out[off:off + size]).reshape(shape)
+            if p.grad is None:
+                p.grad = torch.zeros_like(p)
+            p.grad.copy_(piece.to(p.grad.dtype))
+            off += size
+        self._delay = {id(p): self.backward_passes_per_step
+                       for p in self._grace_params}
+        self._synchronized = True
+
+    def set_backward_passes_per_step(self, passes: int):
+        self.backward_passes_per_step = passes
+        self._delay = {k: passes for k in self._delay}
+
+    @contextmanager
+    def skip_synchronize(self):
+        """Use after a manual ``synchronize()`` so ``step()`` won't redo it
+        (reference protocol, torch/__init__.py:163-177)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    # -- optimizer protocol -------------------------------------------------
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                warnings.warn(
+                    "optimizer.step() called without "
+                    "optimizer.skip_synchronize() context after "
+                    "optimizer.synchronize(). This can cause training "
+                    "slowdown. Consider the skip_synchronize() context.")
+            self.synchronize()
+        self._synchronized = False
+        return super().step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._pending is not None or any(
+                d != self.backward_passes_per_step
+                for d in self._delay.values()):
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step() or optimizer.synchronize(). This is "
+                "prohibited as it can cause a race condition.")
+        return super().zero_grad(*args, **kwargs)
+
+    @property
+    def grace_state(self):
+        """On-device compression state — include it in checkpoints."""
+        return self._bridge.state
+
+
+def DistributedOptimizer(optimizer, grace: Grace, named_parameters=None,
+                         backward_passes_per_step: int = 1,
+                         mesh=None, seed: int = 0):
+    """Wrap a ``torch.optim.Optimizer`` with compressed TPU gradient exchange.
+
+    Drop-in for the reference's ``hvd.DistributedOptimizer(opt, grace, …)``
+    (patch_files/horovod/torch/__init__.py:204-250): dynamically subclasses
+    the user's optimizer class so isinstance checks and attribute access keep
+    working, then rebinds the instance.
+    """
+    cls = type(optimizer.__class__.__name__, (_DistributedOptimizer,
+                                              optimizer.__class__), {})
+    optimizer.__class__ = cls
+    optimizer._grace_init(named_parameters, grace, mesh, seed,
+                          backward_passes_per_step)
+    return optimizer
+
+
+# ---------------------------------------------------------------------------
+# Init-time state synchronisation (reference: torch/__init__.py:253-403)
+# ---------------------------------------------------------------------------
+
+def _broadcast_array(x: np.ndarray, root_rank: int) -> np.ndarray:
+    from jax.experimental import multihost_utils
+    if jax.process_count() == 1:
+        return x
+    return np.asarray(multihost_utils.broadcast_one_to_all(
+        x, is_source=jax.process_index() == root_rank))
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast ``model.state_dict()`` (or (name, tensor) iterable) from
+    ``root_rank`` to all processes, in place."""
+    import torch
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = sorted(params)
+    for _, t in items:
+        if not isinstance(t, torch.Tensor):
+            continue
+        synced = _broadcast_array(t.detach().cpu().numpy(), root_rank)
+        with torch.no_grad():
+            t.copy_(torch.from_numpy(np.array(synced)).to(t.dtype))
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast optimizer state (incl. scalar hyperparameters) from
+    ``root_rank``. Scalars travel as 0-d arrays and are restored to their
+    original Python types — the reference needed 120 lines of type-callback
+    machinery for this (torch/__init__.py:330-403)."""
+    import torch
+    state = optimizer.state_dict()
+
+    def sync(v):
+        if isinstance(v, torch.Tensor):
+            out = _broadcast_array(v.detach().cpu().numpy(), root_rank)
+            return torch.from_numpy(np.array(out)).to(v.dtype)
+        if isinstance(v, bool):
+            return bool(_broadcast_array(np.asarray(int(v)), root_rank))
+        if isinstance(v, (int, float)):
+            out = _broadcast_array(np.asarray(v), root_rank)
+            return type(v)(out)
+        if isinstance(v, dict):
+            return {k: sync(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(sync(x) for x in v)
+        return v   # non-numeric config (str/None): assumed identical
+
+    optimizer.load_state_dict(sync(state))

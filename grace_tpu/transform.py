@@ -203,6 +203,13 @@ def grace_transform(compressor: Compressor, memory: Memory,
         new_mem, new_comp = [], []
         if fused:
             buckets, cdtype = _bucket_views(leaves)
+            if len(state.mem) != len(buckets):
+                raise ValueError(
+                    f"grace state has {len(state.mem)} buffers but the "
+                    f"fusion plan has {len(buckets)} buckets — the state was "
+                    "built under a different fusion setting. Re-init the "
+                    "optimizer state (or restore a checkpoint written with "
+                    "the same fusion config).")
             outs = [None] * len(leaves)
             for b, idxs in enumerate(buckets):
                 rng = jax.random.fold_in(step_key, b)
