@@ -1,0 +1,147 @@
+"""Shared example plumbing: CLI flags, synthetic datasets, idx/CIFAR readers.
+
+The reference's examples each re-declare argparse flags and dataset loading
+(SURVEY.md §2.8); this module factors the common part. Data policy: synthetic
+datasets by default (runs anywhere, zero downloads), with loaders for the
+standard on-disk formats (MNIST idx, CIFAR-10 binary batches) when a
+--data-dir is supplied.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import struct
+
+import numpy as np
+
+GRACE_FLAG_DOC = """GRACE compression flags (reference params-dict schema,
+grace_dl/dist/helper.py): --compressor/--memory/--communicator select the
+triad; per-algorithm hyperparameters have the reference defaults."""
+
+
+def add_grace_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("grace", GRACE_FLAG_DOC)
+    g.add_argument("--compressor", default="none",
+                   help="none|fp16|topk|randomk|threshold|qsgd|terngrad|"
+                        "signsgd|signum|efsignsgd|onebit|natural|dgc|"
+                        "powersgd|u8bit|sketch|adaq|inceptionn")
+    g.add_argument("--memory", default="none",
+                   help="none|residual|efsignsgd|dgc|powersgd")
+    g.add_argument("--communicator", default="allgather",
+                   help="allreduce|allgather|broadcast|identity")
+    g.add_argument("--compress-ratio", type=float, default=0.01)
+    g.add_argument("--quantum-num", type=int, default=64)
+    g.add_argument("--threshold", type=float, default=0.01)
+    g.add_argument("--momentum", type=float, default=0.9)
+    g.add_argument("--compress-rank", type=int, default=4,
+                   help="PowerSGD rank")
+    g.add_argument("--fusion", default="flat",
+                   help="flat|none|<bytes> — gradient fusion buffer")
+    g.add_argument("--seed", type=int, default=42)
+
+
+def grace_params_from_args(args) -> dict:
+    fusion = args.fusion
+    if fusion in ("none", "None", ""):
+        fusion = None
+    elif fusion != "flat":
+        fusion = int(fusion)
+    return {
+        "compressor": args.compressor,
+        "memory": args.memory,
+        "communicator": args.communicator,
+        "compress_ratio": args.compress_ratio,
+        "quantum_num": args.quantum_num,
+        "threshold": args.threshold,
+        "momentum": args.momentum,
+        "compress_rank": args.compress_rank,
+        "fusion": fusion,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Datasets
+# ---------------------------------------------------------------------------
+
+def _synthetic_classification(n, seed, shape, noise, proto_seed):
+    """Class-conditional data: 10 fixed prototype images + per-sample noise.
+    The prototypes come from ``proto_seed`` so train/test splits built with
+    different ``seed`` values share the same underlying task."""
+    protos = np.random.default_rng(proto_seed).standard_normal(
+        (10, *shape)).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n).astype(np.int32)
+    x = protos[y] + noise * rng.standard_normal((n, *shape)).astype(np.float32)
+    return x, y
+
+
+def synthetic_mnist(n: int, seed: int = 0, proto_seed: int = 1234):
+    """Synthetic digits, separable enough that LeNet exceeds 95% quickly."""
+    return _synthetic_classification(n, seed, (28, 28, 1), 0.3, proto_seed)
+
+
+def synthetic_cifar10(n: int, seed: int = 0, proto_seed: int = 1234):
+    return _synthetic_classification(n, seed, (32, 32, 3), 0.5, proto_seed)
+
+
+def load_mnist_idx(data_dir: str, train: bool = True):
+    """Read the standard MNIST idx(.gz) files from ``data_dir``."""
+    prefix = "train" if train else "t10k"
+
+    def _open(name):
+        for cand in (os.path.join(data_dir, name),
+                     os.path.join(data_dir, name + ".gz")):
+            if os.path.exists(cand):
+                return gzip.open(cand, "rb") if cand.endswith(".gz") \
+                    else open(cand, "rb")
+        raise FileNotFoundError(f"{name}[.gz] not found under {data_dir}")
+
+    with _open(f"{prefix}-images-idx3-ubyte") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad idx magic {magic}"
+        x = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols, 1)
+    with _open(f"{prefix}-labels-idx1-ubyte") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad idx magic {magic}"
+        y = np.frombuffer(f.read(), np.uint8).astype(np.int32)
+    x = (x.astype(np.float32) / 255.0 - 0.1307) / 0.3081
+    return x, y
+
+
+def load_cifar10_binary(data_dir: str, train: bool = True):
+    """Read CIFAR-10 binary batches (data_batch_*.bin / test_batch.bin)."""
+    names = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \
+        else ["test_batch.bin"]
+    xs, ys = [], []
+    for name in names:
+        path = os.path.join(data_dir, name)
+        raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
+        ys.append(raw[:, 0].astype(np.int32))
+        xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+    x = np.concatenate(xs).astype(np.float32) / 255.0
+    y = np.concatenate(ys)
+    mean = np.array([0.4914, 0.4822, 0.4465], np.float32)
+    std = np.array([0.2471, 0.2435, 0.2616], np.float32)
+    return (x - mean) / std, y
+
+
+def batches(x, y, batch_size: int, *, shuffle: bool, seed: int,
+            drop_last: bool = True):
+    """Shuffled minibatch iterator over host arrays."""
+    n = x.shape[0]
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    stop = n - (n % batch_size) if drop_last else n
+    for i in range(0, stop, batch_size):
+        sel = idx[i:i + batch_size]
+        yield x[sel], y[sel]
+
+
+def compute_dtype():
+    """bf16 on TPU (MXU-native), f32 elsewhere (bf16 is emulated-slow on CPU)."""
+    import jax
+    import jax.numpy as jnp
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32

@@ -1,0 +1,132 @@
+"""Synthetic throughput benchmark: ResNet / BERT on random data.
+
+TPU-native port of the reference's examples/torch/pytorch_synthetic_benchmark.py
+(and the TF2 twin): fixed random batch, timed iterations, img/sec mean
+±1.96σ. Covers BASELINE.json configs 2/3/5 via the grace flags, e.g.:
+
+    python examples/synthetic_benchmark.py --model resnet50 \\
+        --compressor topk --compress-ratio 0.01 --memory residual
+    python examples/synthetic_benchmark.py --model resnet50 \\
+        --compressor qsgd --quantum-num 128
+    python examples/synthetic_benchmark.py --model resnet50 \\
+        --compressor signsgd --memory residual
+    python examples/synthetic_benchmark.py --model bert \\
+        --compressor powersgd --memory powersgd --communicator allreduce
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from grace_tpu import grace_from_params
+from grace_tpu.models import resnet, transformer
+from grace_tpu.parallel import (batch_sharded, data_parallel_mesh,
+                                initialize_distributed)
+from grace_tpu.train import (init_stateful_train_state,
+                             make_stateful_train_step)
+from grace_tpu.utils import StepTimer, rank_zero_print, wire_report
+
+import common
+
+
+def build(args, mesh):
+    if args.model.startswith("resnet"):
+        depth = int(args.model[len("resnet"):])
+        params, mstate = resnet.init(jax.random.key(args.seed), depth=depth,
+                                     num_classes=args.num_classes)
+
+        def loss_fn(params, mstate, batch):
+            x, y = batch
+            logits, new_mstate = resnet.apply(
+                params, mstate, x.astype(common.compute_dtype()), train=True)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return loss.mean(), new_mstate
+
+        rng = np.random.default_rng(args.seed)
+        n = args.batch_size * mesh.devices.size
+        data = (jnp.asarray(rng.standard_normal(
+                    (n, args.image_size, args.image_size, 3)), jnp.float32),
+                jnp.asarray(rng.integers(0, args.num_classes, (n,)),
+                            jnp.int32))
+    elif args.model == "bert":
+        cfg = transformer.base(num_classes=args.num_classes)
+        params, mstate = transformer.init(jax.random.key(args.seed), cfg)
+
+        def loss_fn(params, mstate, batch):
+            ids, y = batch
+            logits, new_mstate = transformer.apply(
+                params, mstate, ids, cfg=cfg, dtype=common.compute_dtype())
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return loss.mean(), new_mstate
+
+        rng = np.random.default_rng(args.seed)
+        n = args.batch_size * mesh.devices.size
+        data = (jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                         (n, args.seq_len)), jnp.int32),
+                jnp.asarray(rng.integers(0, args.num_classes, (n,)),
+                            jnp.int32))
+    else:
+        raise SystemExit(f"unknown --model {args.model}")
+    return params, mstate, loss_fn, data
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    common.add_grace_args(parser)
+    parser.add_argument("--model", default="resnet50",
+                        help="resnet50|resnet101|resnet152|bert")
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="per-device batch (reference default 32)")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-iters", type=int, default=10,
+                        help="timed iterations (reference protocol: 10)")
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+
+    initialize_distributed()
+    mesh = data_parallel_mesh()
+    params, mstate, loss_fn, data = build(args, mesh)
+
+    grace = grace_from_params(common.grace_params_from_args(args))
+    optimizer = optax.chain(grace.transform(seed=args.seed),
+                            optax.sgd(args.lr))
+    step = make_stateful_train_step(loss_fn, optimizer, mesh)
+    ts = init_stateful_train_state(params, mstate, optimizer, mesh)
+    batch = jax.device_put(data, batch_sharded(mesh))
+
+    rank_zero_print(f"Model: {args.model}, global batch "
+                    f"{batch[1].shape[0]} over {mesh.devices.size} devices")
+    rank_zero_print("wire cost:", wire_report(grace.compressor, params))
+
+    for _ in range(args.num_warmup_batches):
+        ts, loss = step(ts, batch)
+    jax.block_until_ready(ts)
+
+    items = batch[1].shape[0] * args.num_batches_per_iter
+    timer = StepTimer(warmup=0)
+    for i in range(args.num_iters):
+        with timer.step():
+            for _ in range(args.num_batches_per_iter):
+                ts, loss = step(ts, batch)
+            timer.sync_on(loss)
+        rank_zero_print(f"Iter #{i}: {items / timer.steady[-1]:.1f} "
+                        f"{'img' if 'resnet' in args.model else 'seq'}/sec")
+
+    unit = "img" if "resnet" in args.model else "seq"
+    rank_zero_print(f"{unit}/sec: {timer.throughput(items):.1f} "
+                    f"+-{timer.confidence95(items):.1f}")
+    rank_zero_print(f"{unit}/sec/device: "
+                    f"{timer.throughput(items) / mesh.devices.size:.1f}")
+
+
+if __name__ == "__main__":
+    main()
