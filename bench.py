@@ -46,15 +46,32 @@ def _build_step(grace_params, mesh, num_classes, sgd_lr=1e-3):
 
 
 def _throughput(step, ts, batch, n_batches, warmup=2):
-    from grace_tpu.utils import StepTimer
-    timer = StepTimer(warmup=warmup)
-    for _ in range(warmup + n_batches):
-        with timer.step():
-            ts, loss = step(ts, batch)
-            timer.sync_on(loss)
-    # Return the final state too: the step donates its input buffers, so
-    # callers must thread the live state into any further timed runs.
-    return timer.throughput(items_per_step=batch[1].shape[0]), ts
+    """Fetch-bounded timing window.
+
+    On remote-tunneled platforms (axon) `jax.block_until_ready` does NOT
+    wait for device execution — only a value fetch truly synchronizes. So:
+    drain the queue with a fetch, time n dependent steps bounded by a final
+    fetch, and subtract the measured fetch round-trip so the window covers
+    device execution, not tunnel latency. Returns (imgs/sec, final state) —
+    the step donates its inputs, so callers must thread the live state.
+    """
+    import time
+
+    for _ in range(warmup):
+        ts, loss = step(ts, batch)
+    float(loss)                      # drain: all queued work done
+    # RTT on a fresh trivial computation — re-fetching `loss` would hit
+    # jax's cached host copy and measure nothing.
+    t0 = time.perf_counter()
+    float(loss + 1.0)
+    rtt = time.perf_counter() - t0   # tiny-dispatch + fetch round-trip
+
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        ts, loss = step(ts, batch)
+    float(loss)                      # bounds the window: steps are dependent
+    dt = max(1e-9, time.perf_counter() - t0 - rtt)
+    return batch[1].shape[0] * n_batches / dt, ts
 
 
 def main():

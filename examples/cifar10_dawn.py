@@ -82,6 +82,10 @@ def main():
         x_train, y_train = common.synthetic_cifar10(args.train_size, args.seed)
         x_test, y_test = common.synthetic_cifar10(2048, args.seed + 1)
 
+    if len(x_train) < args.batch_size or len(x_test) < args.batch_size:
+        raise SystemExit(f"--batch-size {args.batch_size} exceeds dataset "
+                         f"split sizes ({len(x_train)} train / {len(x_test)} "
+                         "test)")
     steps_per_epoch = len(x_train) // args.batch_size
     grace = grace_from_params(common.grace_params_from_args(args))
     schedule = lambda step: piecewise_linear_lr(  # noqa: E731

@@ -39,6 +39,12 @@ def add_grace_args(parser: argparse.ArgumentParser) -> None:
                    help="PowerSGD rank")
     g.add_argument("--fusion", default="flat",
                    help="flat|none|<bytes> — gradient fusion buffer")
+    g.add_argument("--topk-algorithm", default="exact",
+                   help="exact|approx|chunk — top-k selection strategy")
+    g.add_argument("--recall-target", type=float, default=0.95,
+                   help="recall for --topk-algorithm approx")
+    g.add_argument("--use-pallas", action="store_true",
+                   help="fused Pallas quantization kernel (qsgd)")
     g.add_argument("--seed", type=int, default=42)
 
 
@@ -58,6 +64,9 @@ def grace_params_from_args(args) -> dict:
         "momentum": args.momentum,
         "compress_rank": args.compress_rank,
         "fusion": fusion,
+        "topk_algorithm": args.topk_algorithm,
+        "recall_target": args.recall_target,
+        "use_pallas": args.use_pallas,
     }
 
 

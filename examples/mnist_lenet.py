@@ -63,6 +63,10 @@ def main():
         x_train, y_train = common.synthetic_mnist(args.train_size, args.seed)
         x_test, y_test = common.synthetic_mnist(4096, args.seed + 1)
 
+    if len(x_train) < args.batch_size or len(x_test) < args.batch_size:
+        raise SystemExit(f"--batch-size {args.batch_size} exceeds dataset "
+                         f"split sizes ({len(x_train)} train / {len(x_test)} "
+                         "test)")
     grace_params = common.grace_params_from_args(args)
     grace = grace_from_params(grace_params)
     optimizer = optax.chain(grace.transform(seed=args.seed),

@@ -17,6 +17,7 @@ TPU-native port of the reference's examples/torch/pytorch_synthetic_benchmark.py
 from __future__ import annotations
 
 import argparse
+import time
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +30,7 @@ from grace_tpu.parallel import (batch_sharded, data_parallel_mesh,
                                 initialize_distributed)
 from grace_tpu.train import (init_stateful_train_state,
                              make_stateful_train_step)
-from grace_tpu.utils import StepTimer, rank_zero_print, wire_report
+from grace_tpu.utils import rank_zero_print, wire_report
 
 import common
 
@@ -107,25 +108,28 @@ def main():
                     f"{batch[1].shape[0]} over {mesh.devices.size} devices")
     rank_zero_print("wire cost:", wire_report(grace.compressor, params))
 
+    loss = None
     for _ in range(args.num_warmup_batches):
         ts, loss = step(ts, batch)
-    jax.block_until_ready(ts)
+    if loss is not None:
+        float(loss)   # true sync: on tunneled platforms only a value fetch
+                      # waits for execution (block_until_ready returns early)
 
     items = batch[1].shape[0] * args.num_batches_per_iter
-    timer = StepTimer(warmup=0)
-    for i in range(args.num_iters):
-        with timer.step():
-            for _ in range(args.num_batches_per_iter):
-                ts, loss = step(ts, batch)
-            timer.sync_on(loss)
-        rank_zero_print(f"Iter #{i}: {items / timer.steady[-1]:.1f} "
-                        f"{'img' if 'resnet' in args.model else 'seq'}/sec")
-
     unit = "img" if "resnet" in args.model else "seq"
-    rank_zero_print(f"{unit}/sec: {timer.throughput(items):.1f} "
-                    f"+-{timer.confidence95(items):.1f}")
-    rank_zero_print(f"{unit}/sec/device: "
-                    f"{timer.throughput(items) / mesh.devices.size:.1f}")
+    per_iter = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            ts, loss = step(ts, batch)
+        float(loss)   # fetch bounds the window (steps are dependent)
+        per_iter.append(items / (time.perf_counter() - t0))
+        rank_zero_print(f"Iter #{i}: {per_iter[-1]:.1f} {unit}/sec")
+
+    mean = float(np.mean(per_iter))
+    rank_zero_print(f"{unit}/sec: {mean:.1f} "
+                    f"+-{1.96 * float(np.std(per_iter)):.1f}")
+    rank_zero_print(f"{unit}/sec/device: {mean / mesh.devices.size:.1f}")
 
 
 if __name__ == "__main__":

@@ -22,12 +22,33 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 @dataclasses.dataclass(frozen=True)
 class QSGDCompressor(Compressor):
     quantum_num: int = 64
+    # Fused Pallas TPU kernel for the quantize step (in-core PRNG, one HBM
+    # pass — see grace_tpu/ops/pallas_quant.py). 'auto': on for TPU,
+    # interpreter-mode off elsewhere; True forces interpret mode off-TPU.
+    use_pallas: bool | str = False
+
+    def _pallas_mode(self):
+        if self.use_pallas == "auto":
+            return jax.default_backend() == "tpu", False
+        if self.use_pallas:
+            on_tpu = jax.default_backend() == "tpu"
+            return True, not on_tpu
+        return False, False
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
         shape = x.shape
         flat = x.reshape(-1)
         norm = jnp.linalg.norm(flat)
+        dtype = jnp.int8 if self.quantum_num < 128 else jnp.int16
+        enabled, interpret = self._pallas_mode()
+        if enabled:
+            from grace_tpu.ops.pallas_quant import quantize_stochastic
+            seed = jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
+            signed = quantize_stochastic(flat, norm, seed, self.quantum_num,
+                                         out_dtype=dtype,
+                                         interpret=interpret)
+            return (signed, norm), (shape, x.dtype), state
         abs_g = jnp.abs(flat)
         level_float = jnp.where(norm > 0, self.quantum_num / norm * abs_g, 0.0)
         previous_level = jnp.floor(level_float)
@@ -35,7 +56,6 @@ class QSGDCompressor(Compressor):
         is_next = (prob < (level_float - previous_level)).astype(flat.dtype)
         new_level = previous_level + is_next
         signed = new_level * jnp.sign(flat)
-        dtype = jnp.int8 if self.quantum_num < 128 else jnp.int16
         return (signed.astype(dtype), norm), (shape, x.dtype), state
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:

@@ -52,13 +52,17 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
     if name in ("fp16", "bf16", "bfloat16"):
         return C.FP16Compressor(dtype="float16" if name == "fp16" else "bfloat16")
     if name == "topk":
-        return C.TopKCompressor(compress_ratio=ratio)
+        return C.TopKCompressor(
+            compress_ratio=ratio,
+            algorithm=params.get("topk_algorithm", "exact"),
+            recall_target=params.get("recall_target", 0.95))
     if name == "randomk":
         return C.RandomKCompressor(compress_ratio=ratio)
     if name == "threshold":
         return C.ThresholdCompressor(threshold=params.get("threshold", 0.01))
     if name == "qsgd":
-        return C.QSGDCompressor(quantum_num=params.get("quantum_num", 64))
+        return C.QSGDCompressor(quantum_num=params.get("quantum_num", 64),
+                                use_pallas=params.get("use_pallas", False))
     if name == "terngrad":
         return C.TernGradCompressor()
     if name == "signsgd":

@@ -1,0 +1,102 @@
+"""Pallas TPU kernel: fused stochastic quantization.
+
+The QSGD family (reference grace_dl/dist/compressor/qsgd.py:19-23) needs a
+uniform random draw per element for stochastic rounding. Expressed in plain
+jnp, XLA materializes the threefry random tensor and streams it through HBM
+alongside the gradient; this kernel keeps the whole quantize step — scale,
+floor, random draw, round, sign fold — in VMEM with the TPU's in-core PRNG
+(`pltpu.prng_random_bits`), one HBM read + one (8× smaller) HBM write.
+
+Layout: the flat tensor is processed as (rows, 256) f32 blocks (sublane
+multiple of 8, lane 128×2), grid over row-tiles. Padding lanes quantize
+garbage that callers slice off.
+
+Used by ``QSGDCompressor(use_pallas=True)``; runs in interpreter mode on
+CPU so the test suite exercises the same code path everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 256          # last-dim tile (2 × 128 lanes)
+ROWS_PER_BLOCK = 64  # sublane tile multiple
+
+
+def _hash_bits(seed, shape):
+    """Counter-based uint32 hash (xorshift-multiply) over element indices.
+
+    Used when the hardware PRNG is unavailable (CPU interpreter mode, where
+    `pltpu.prng_random_bits` silently returns zeros) — same numerics as the
+    TPU path, just a different bit source, so the full quantization logic is
+    testable off-TPU.
+    """
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    h = (rows * jnp.uint32(shape[1]) + cols) * jnp.uint32(2654435761)
+    h = h + seed.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x45D9F3B)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x45D9F3B)
+    return h ^ (h >> 16)
+
+
+def _make_quantize_kernel(hw_prng: bool):
+    def kernel(seed_ref, scale_ref, x_ref, out_ref):
+        block_seed = seed_ref[0] + pl.program_id(0)
+        x = x_ref[:]
+        level_float = jnp.abs(x) * scale_ref[0]
+        previous = jnp.floor(level_float)
+        if hw_prng:
+            pltpu.prng_seed(block_seed)
+            bits = pltpu.prng_random_bits(x.shape).astype(jnp.uint32)
+        else:
+            bits = _hash_bits(block_seed, x.shape)
+        # Top 24 bits -> uniform [0, 1) with full f32 mantissa coverage.
+        u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        level = previous + (u < level_float - previous).astype(jnp.float32)
+        out_ref[:] = (level * jnp.sign(x)).astype(out_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("quantum_num", "out_dtype", "interpret"))
+def quantize_stochastic(flat: jax.Array, norm: jax.Array, seed: jax.Array,
+                        quantum_num: int, out_dtype=jnp.int8,
+                        interpret: bool = False) -> jax.Array:
+    """Stochastically quantize ``flat`` (1-D f32) to signed integer levels.
+
+    level ~ floor(q/||x|| * |x|) + Bernoulli(frac), sign folded in — the
+    QSGD encoding. ``norm`` is the (precomputed) L2 norm; ``seed`` an int32
+    scalar. Returns int levels, same length as ``flat``.
+    """
+    n = flat.size
+    block = ROWS_PER_BLOCK * LANES
+    n_pad = -n % block
+    padded = jnp.pad(flat.astype(jnp.float32), (0, n_pad))
+    rows = padded.size // LANES
+    x2d = padded.reshape(rows, LANES)
+    scale = jnp.where(norm > 0, quantum_num / norm, 0.0).astype(jnp.float32)
+
+    out = pl.pallas_call(
+        _make_quantize_kernel(hw_prng=not interpret),
+        grid=(rows // ROWS_PER_BLOCK,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((ROWS_PER_BLOCK, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROWS_PER_BLOCK, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), out_dtype),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed.reshape(1).astype(jnp.int32), scale.reshape(1), x2d)
+    return out.reshape(-1)[:n]

@@ -238,3 +238,70 @@ def test_compressor_hashable():
     """Frozen dataclasses: usable as static jit args / dict keys."""
     assert hash(C.TopKCompressor(0.5)) == hash(C.TopKCompressor(0.5))
     assert C.TopKCompressor(0.5) != C.TopKCompressor(0.25)
+
+
+class TestTopKAlgorithms:
+    """TPU-first selection variants share the exact variant's wire format."""
+
+    def _roundtrip(self, algo, n=10000, ratio=0.01):
+        from grace_tpu.compressors import TopKCompressor
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+        c = TopKCompressor(compress_ratio=ratio, algorithm=algo)
+        (vals, idx), ctx, _ = jax.jit(
+            lambda x: c.compress(x, None, jax.random.key(0)))(x)
+        k = max(1, int(n * ratio))
+        assert vals.shape == (k,) and idx.shape == (k,)
+        assert jnp.all(idx >= 0) and jnp.all(idx < n)
+        np.testing.assert_allclose(np.asarray(vals),
+                                   np.asarray(x)[np.asarray(idx)])
+        dec = c.decompress((vals, idx), ctx)
+        assert dec.shape == x.shape
+        return x, vals, idx
+
+    def test_exact_is_true_topk(self):
+        x, vals, idx = self._roundtrip("exact")
+        thresh = np.sort(np.abs(np.asarray(x)))[-100]
+        assert np.all(np.abs(np.asarray(vals)) >= thresh - 1e-6)
+
+    def test_approx_high_recall(self):
+        x, vals, idx = self._roundtrip("approx")
+        exact = set(np.argsort(np.abs(np.asarray(x)))[-100:].tolist())
+        got = set(np.asarray(idx).tolist())
+        assert len(exact & got) / 100 >= 0.9
+
+    def test_chunk_selects_chunk_maxima(self):
+        # Strided chunks: chunk c = elements {c, c+k, c+2k, ...}.
+        x, vals, idx = self._roundtrip("chunk")
+        xn = np.abs(np.asarray(x))
+        k = 100  # n=10000, ratio=0.01
+        for c, i in enumerate(np.asarray(idx)):
+            members = xn[c::k]
+            assert i % k == c
+            assert xn[i] == members.max()
+
+    def test_chunk_indices_unique_and_cover(self):
+        _, _, idx = self._roundtrip("chunk", n=10007, ratio=0.013)
+        idx = np.asarray(idx)
+        assert len(np.unique(idx)) == len(idx)
+
+    @pytest.mark.parametrize("n,ratio", [
+        (27, 0.3),          # pad spans whole contiguous chunks (regression)
+        (25_557, 0.01),     # ResNet-50-like shape scaled down
+        (101, 0.5),
+    ])
+    def test_chunk_indices_in_range_awkward_shapes(self, n, ratio):
+        """Regression: contiguous chunking emitted out-of-range indices when
+        the tail padding spanned whole chunks; strided chunking cannot."""
+        self._roundtrip("chunk", n=n, ratio=ratio)
+
+    def test_unknown_algorithm_rejected(self):
+        from grace_tpu.compressors import TopKCompressor
+        with pytest.raises(ValueError, match="algorithm"):
+            TopKCompressor(algorithm="banana")
+
+    def test_helper_plumbs_algorithm(self):
+        from grace_tpu import grace_from_params
+        g = grace_from_params({"compressor": "topk", "compress_ratio": 0.01,
+                               "topk_algorithm": "chunk"})
+        assert g.compressor.algorithm == "chunk"
