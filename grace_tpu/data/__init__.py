@@ -1,0 +1,252 @@
+"""Host data pipeline: native threaded loader with a pure-Python fallback.
+
+The reference's input path is torch ``DataLoader`` worker processes +
+``DistributedSampler`` (examples/torch/pytorch_mnist.py:63-70); grace-tpu's
+is a first-party C++ library (native/dataloader.cpp): worker threads
+assemble normalized float32 batches into a bounded prefetch queue while the
+TPU executes the previous step, with deterministic cross-process epoch
+shuffling and rank-strided sharding.
+
+`NativeLoader` binds it via ctypes (no pybind11 dependency). If the shared
+library has not been built (``make -C native``), `make_loader` transparently
+falls back to `PythonLoader`, a numpy implementation of the same contract:
+
+    loader = make_loader(MemoryDataset(x_uint8, y, mean, std), batch_size=512,
+                         seed=0, rank=0, world=1)
+    for epoch in range(E):
+        for x, y in loader.epoch(epoch):   # x: (B,H,W,C) f32, y: (B,) i32
+            ...
+
+Epoch iteration order is a pure function of (seed, epoch), identical across
+ranks; rank r consumes the strided slice r::world of each epoch permutation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["MemoryDataset", "NativeLoader", "PythonLoader", "make_loader",
+           "native_library_path", "mnist_dataset", "cifar10_dataset"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_DEFAULT_LIB = os.path.join(_REPO_ROOT, "native", "libgrace_data.so")
+
+
+def native_library_path() -> Optional[str]:
+    """Path to the built native library, or None if absent."""
+    path = os.environ.get("GRACE_TPU_NATIVE_LIB", _DEFAULT_LIB)
+    return path if os.path.exists(path) else None
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryDataset:
+    """In-memory uint8 NHWC dataset + per-channel normalization stats.
+
+    ``mean``/``std`` are in [0,1] units (multiplied by 255 internally),
+    matching the torchvision convention the reference uses.
+    """
+
+    images: np.ndarray          # (n, h, w, c) uint8
+    labels: np.ndarray          # (n,) int32
+    mean: Optional[Tuple[float, ...]] = None
+    std: Optional[Tuple[float, ...]] = None
+
+    def __post_init__(self):
+        if self.images.dtype != np.uint8 or self.images.ndim != 4:
+            raise ValueError("images must be (n,h,w,c) uint8")
+        if len(self.labels) != len(self.images):
+            raise ValueError("labels/images length mismatch")
+
+    def normalize(self, raw: np.ndarray) -> np.ndarray:
+        x = raw.astype(np.float32)
+        if self.mean is None:
+            return x / 255.0
+        mean = np.asarray(self.mean, np.float32) * 255.0
+        std = np.asarray(self.std, np.float32) * 255.0
+        return (x - mean) / std
+
+
+def _read_idx(data_dir, train):
+    import gzip
+    import struct
+    prefix = "train" if train else "t10k"
+
+    def _open(name):
+        for cand in (os.path.join(data_dir, name),
+                     os.path.join(data_dir, name + ".gz")):
+            if os.path.exists(cand):
+                return gzip.open(cand, "rb") if cand.endswith(".gz") \
+                    else open(cand, "rb")
+        raise FileNotFoundError(f"{name}[.gz] not found under {data_dir}")
+
+    with _open(f"{prefix}-images-idx3-ubyte") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051
+        x = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols, 1)
+    with _open(f"{prefix}-labels-idx1-ubyte") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049
+        y = np.frombuffer(f.read(), np.uint8).astype(np.int32)
+    return x, y
+
+
+def mnist_dataset(data_dir: str, train: bool = True) -> MemoryDataset:
+    """MNIST idx(.gz) files -> MemoryDataset with the standard stats."""
+    x, y = _read_idx(data_dir, train)
+    return MemoryDataset(x, y, mean=(0.1307,), std=(0.3081,))
+
+
+def cifar10_dataset(data_dir: str, train: bool = True) -> MemoryDataset:
+    names = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \
+        else ["test_batch.bin"]
+    xs, ys = [], []
+    for name in names:
+        raw = np.fromfile(os.path.join(data_dir, name), np.uint8)
+        raw = raw.reshape(-1, 3073)
+        ys.append(raw[:, 0].astype(np.int32))
+        xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+    return MemoryDataset(np.concatenate(xs), np.concatenate(ys),
+                         mean=(0.4914, 0.4822, 0.4465),
+                         std=(0.2471, 0.2435, 0.2616))
+
+
+class _LoaderBase:
+    batch_size: int
+    shape: Tuple[int, int, int]
+
+    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        raise NotImplementedError
+
+
+class NativeLoader(_LoaderBase):
+    """ctypes binding over native/dataloader.cpp."""
+
+    def __init__(self, dataset: MemoryDataset, batch_size: int, *,
+                 shuffle: bool = True, drop_last: bool = True, seed: int = 0,
+                 rank: int = 0, world: int = 1, n_threads: int = 4,
+                 queue_depth: int = 4, lib_path: Optional[str] = None):
+        path = lib_path or native_library_path()
+        if path is None:
+            raise FileNotFoundError(
+                "native library not built — run `make -C native` (or set "
+                "GRACE_TPU_NATIVE_LIB)")
+        lib = ctypes.CDLL(path)
+        lib.gl_open_memory.restype = ctypes.c_void_p
+        lib.gl_open_memory.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.gl_start_epoch.restype = ctypes.c_int64
+        lib.gl_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_int64, ctypes.c_int64]
+        lib.gl_next.restype = ctypes.c_int
+        lib.gl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_void_p]
+        lib.gl_close.argtypes = [ctypes.c_void_p]
+        lib.gl_last_error.restype = ctypes.c_char_p
+        self._lib = lib
+
+        imgs = np.ascontiguousarray(dataset.images)
+        labs = np.ascontiguousarray(dataset.labels.astype(np.int32))
+        n, h, w, c = imgs.shape
+        mean = std = None
+        if dataset.mean is not None:
+            mean = np.zeros(3, np.float32)
+            std = np.ones(3, np.float32)
+            mean[:c] = np.asarray(dataset.mean, np.float32)
+            std[:c] = np.asarray(dataset.std, np.float32)
+        self._handle = lib.gl_open_memory(
+            imgs.ctypes.data_as(ctypes.c_void_p),
+            labs.ctypes.data_as(ctypes.c_void_p),
+            n, h, w, c,
+            mean.ctypes.data_as(ctypes.c_void_p) if mean is not None else None,
+            std.ctypes.data_as(ctypes.c_void_p) if std is not None else None,
+            batch_size, int(shuffle), int(drop_last), seed, rank, world)
+        if not self._handle:
+            raise RuntimeError(lib.gl_last_error().decode())
+        self.batch_size = batch_size
+        self.shape = (h, w, c)
+        self._n_threads = n_threads
+        self._queue_depth = queue_depth
+
+    def epoch(self, epoch: int):
+        h, w, c = self.shape
+        n_batches = self._lib.gl_start_epoch(self._handle, epoch,
+                                             self._n_threads,
+                                             self._queue_depth)
+        for _ in range(n_batches):
+            x = np.empty((self.batch_size, h, w, c), np.float32)
+            y = np.empty((self.batch_size,), np.int32)
+            rc = self._lib.gl_next(self._handle,
+                                   x.ctypes.data_as(ctypes.c_void_p),
+                                   y.ctypes.data_as(ctypes.c_void_p))
+            if rc != 1:
+                raise RuntimeError("native loader stopped mid-epoch")
+            yield x, y
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.gl_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PythonLoader(_LoaderBase):
+    """Numpy implementation of the identical contract (fallback/reference)."""
+
+    def __init__(self, dataset: MemoryDataset, batch_size: int, *,
+                 shuffle: bool = True, drop_last: bool = True, seed: int = 0,
+                 rank: int = 0, world: int = 1, **_ignored):
+        self.ds = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.rank = rank
+        self.world = world
+        n, h, w, c = dataset.images.shape
+        self.shape = (h, w, c)
+
+    def epoch(self, epoch: int):
+        n = len(self.ds.images)
+        perm = np.arange(n)
+        if self.shuffle:
+            # Same Fisher-Yates + seeding contract as the native library —
+            # NOT bit-identical to it (different RNG), but deterministic and
+            # rank-disjoint in the same way.
+            np.random.default_rng(
+                (self.seed * 0x9E3779B97F4A7C15 + epoch) % 2**63
+            ).shuffle(perm)
+        order = perm[self.rank::self.world]
+        b = self.batch_size
+        stop = len(order) - (len(order) % b) if self.drop_last else len(order)
+        for i in range(0, stop, b):
+            count = min(b, len(order) - i)
+            # Short final batch wraps deterministically (native contract).
+            sel = order[i + (np.arange(b) % count)]
+            yield (self.ds.normalize(self.ds.images[sel]),
+                   self.ds.labels[sel].astype(np.int32))
+
+
+def make_loader(dataset: MemoryDataset, batch_size: int,
+                **kwargs) -> _LoaderBase:
+    """NativeLoader if the shared library is built, else PythonLoader."""
+    if native_library_path() is not None:
+        try:
+            return NativeLoader(dataset, batch_size, **kwargs)
+        except (OSError, RuntimeError):
+            pass
+    return PythonLoader(dataset, batch_size, **kwargs)

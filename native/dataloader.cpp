@@ -1,0 +1,437 @@
+// grace-tpu native data pipeline: threaded, prefetching batch loader.
+//
+// TPU-native replacement for the host-side input machinery the reference
+// delegates to torch (DataLoader worker processes + DistributedSampler,
+// examples/torch/pytorch_mnist.py:63-70) and tf.data. The training step is
+// one jitted XLA program, so the host's only job is to keep batches ready
+// ahead of device consumption — exactly what this library does: worker
+// threads assemble normalized float32 batches into a bounded queue while
+// the previous step runs on the TPU.
+//
+// Design:
+//   * Dataset: raw samples held in memory as uint8 (images) + int32 labels.
+//     Loaders for MNIST idx(.gz) and CIFAR-10 binary batches; arbitrary
+//     in-memory datasets can be registered from the host language.
+//   * Sampler: per-epoch Fisher-Yates shuffle from a counter-based seed
+//     (seed, epoch) — deterministic and identical on every process — then
+//     rank r takes the strided slice r::world (the DistributedSampler
+//     contract, so ranks partition each epoch disjointly).
+//   * Pipeline: N worker threads claim batch indices from an atomic
+//     counter, normalize ((x/255 - mean)/std) into preallocated slots of a
+//     bounded ring, and a consumer thread hands slots to the caller in
+//     batch order. Backpressure via condition variables, capacity fixed at
+//     queue_depth batches.
+//
+// C ABI (for ctypes): every function returns 0 on success, negative on
+// error; gl_last_error() describes the most recent failure per handle-less
+// thread.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+// ---------------------------------------------------------------------------
+// File readers
+// ---------------------------------------------------------------------------
+
+bool read_file_maybe_gz(const std::string& path, std::vector<uint8_t>* out) {
+  // gzread transparently handles both plain and gzip files.
+  gzFile f = gzopen(path.c_str(), "rb");
+  if (!f) {
+    set_error("cannot open " + path);
+    return false;
+  }
+  out->clear();
+  constexpr size_t kChunk = 1 << 20;
+  std::vector<uint8_t> buf(kChunk);
+  int n;
+  while ((n = gzread(f, buf.data(), kChunk)) > 0) {
+    out->insert(out->end(), buf.data(), buf.data() + n);
+  }
+  gzclose(f);
+  if (n < 0) {
+    set_error("read error on " + path);
+    return false;
+  }
+  return true;
+}
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+// ---------------------------------------------------------------------------
+// Dataset
+// ---------------------------------------------------------------------------
+
+struct Dataset {
+  std::vector<uint8_t> images;  // n * h * w * c, NHWC
+  std::vector<int32_t> labels;  // n
+  int64_t n = 0, h = 0, w = 0, c = 0;
+  float mean[3] = {0, 0, 0};
+  float inv_std[3] = {1, 1, 1};
+
+  int64_t sample_size() const { return h * w * c; }
+};
+
+bool exists(const std::string& p) {
+  if (FILE* f = fopen(p.c_str(), "rb")) {
+    fclose(f);
+    return true;
+  }
+  return false;
+}
+
+std::string pick(const std::string& base) {
+  if (exists(base)) return base;
+  if (exists(base + ".gz")) return base + ".gz";
+  return "";
+}
+
+bool load_mnist(const std::string& dir, bool train, Dataset* ds) {
+  const std::string prefix = train ? "train" : "t10k";
+  std::string ip = pick(dir + "/" + prefix + "-images-idx3-ubyte");
+  std::string lp = pick(dir + "/" + prefix + "-labels-idx1-ubyte");
+  if (ip.empty() || lp.empty()) {
+    set_error("MNIST idx files not found under " + dir);
+    return false;
+  }
+  std::vector<uint8_t> ib, lb;
+  if (!read_file_maybe_gz(ip, &ib) || !read_file_maybe_gz(lp, &lb))
+    return false;
+  if (ib.size() < 16 || be32(ib.data()) != 2051) {
+    set_error("bad idx image magic in " + ip);
+    return false;
+  }
+  if (lb.size() < 8 || be32(lb.data()) != 2049) {
+    set_error("bad idx label magic in " + lp);
+    return false;
+  }
+  ds->n = be32(ib.data() + 4);
+  ds->h = be32(ib.data() + 8);
+  ds->w = be32(ib.data() + 12);
+  ds->c = 1;
+  if ((int64_t)ib.size() - 16 < ds->n * ds->sample_size()) {
+    set_error("truncated " + ip);
+    return false;
+  }
+  ds->images.assign(ib.begin() + 16,
+                    ib.begin() + 16 + ds->n * ds->sample_size());
+  ds->labels.resize(ds->n);
+  for (int64_t i = 0; i < ds->n; ++i) ds->labels[i] = lb[8 + i];
+  ds->mean[0] = 0.1307f * 255.0f;
+  ds->inv_std[0] = 1.0f / (0.3081f * 255.0f);
+  return true;
+}
+
+bool load_cifar10(const std::string& dir, bool train, Dataset* ds) {
+  std::vector<std::string> names;
+  if (train) {
+    for (int i = 1; i <= 5; ++i)
+      names.push_back(dir + "/data_batch_" + std::to_string(i) + ".bin");
+  } else {
+    names.push_back(dir + "/test_batch.bin");
+  }
+  ds->h = ds->w = 32;
+  ds->c = 3;
+  ds->n = 0;
+  constexpr int64_t kRec = 3073;  // label + 3*32*32 CHW
+  for (const auto& name : names) {
+    std::vector<uint8_t> raw;
+    if (!read_file_maybe_gz(name, &raw)) return false;
+    if (raw.size() % kRec) {
+      set_error("bad CIFAR record size in " + name);
+      return false;
+    }
+    int64_t records = raw.size() / kRec;
+    for (int64_t r = 0; r < records; ++r) {
+      const uint8_t* rec = raw.data() + r * kRec;
+      ds->labels.push_back(rec[0]);
+      // CHW -> HWC
+      for (int64_t y = 0; y < 32; ++y)
+        for (int64_t x = 0; x < 32; ++x)
+          for (int64_t ch = 0; ch < 3; ++ch)
+            ds->images.push_back(rec[1 + ch * 1024 + y * 32 + x]);
+    }
+    ds->n += records;
+  }
+  const float mean[3] = {0.4914f, 0.4822f, 0.4465f};
+  const float stdv[3] = {0.2471f, 0.2435f, 0.2616f};
+  for (int i = 0; i < 3; ++i) {
+    ds->mean[i] = mean[i] * 255.0f;
+    ds->inv_std[i] = 1.0f / (stdv[i] * 255.0f);
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Loader: sampler + prefetch pipeline
+// ---------------------------------------------------------------------------
+
+struct Slot {
+  std::vector<float> x;
+  std::vector<int32_t> y;
+  int64_t batch_idx = -1;
+  bool ready = false;
+};
+
+struct Loader {
+  Dataset ds;
+  int64_t batch = 0;
+  int64_t rank = 0, world = 1;
+  uint64_t seed = 0;
+  bool shuffle = true;
+  bool drop_last = true;
+
+  // epoch state
+  int64_t epoch = -1;
+  std::vector<int64_t> order;       // this rank's sample order for the epoch
+  int64_t batches_per_epoch = 0;
+
+  // pipeline
+  std::vector<std::thread> workers;
+  std::vector<Slot> slots;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::atomic<int64_t> next_claim{0};
+  int64_t next_serve = 0;
+  bool stopping = false;
+
+  ~Loader() { stop(); }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> l(mu);
+      stopping = true;
+    }
+    cv_free.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers) {
+      if (t.joinable()) t.join();
+    }
+    workers.clear();
+  }
+
+  void build_epoch(int64_t e) {
+    epoch = e;
+    std::vector<int64_t> perm(ds.n);
+    for (int64_t i = 0; i < ds.n; ++i) perm[i] = i;
+    if (shuffle) {
+      std::mt19937_64 rng(seed * 0x9E3779B97F4A7C15ULL + uint64_t(e));
+      for (int64_t i = ds.n - 1; i > 0; --i) {
+        std::uniform_int_distribution<int64_t> d(0, i);
+        std::swap(perm[i], perm[d(rng)]);
+      }
+    }
+    order.clear();
+    for (int64_t i = rank; i < ds.n; i += world) order.push_back(perm[i]);
+    int64_t local = (int64_t)order.size();
+    batches_per_epoch =
+        drop_last ? local / batch : (local + batch - 1) / batch;
+  }
+
+  void fill(Slot* s, int64_t b) {
+    const int64_t ss = ds.sample_size();
+    const int64_t start = b * batch;
+    const int64_t count =
+        std::min<int64_t>(batch, (int64_t)order.size() - start);
+    s->x.resize(batch * ss);
+    s->y.resize(batch);
+    for (int64_t j = 0; j < batch; ++j) {
+      // Short final batch wraps deterministically (only when !drop_last).
+      const int64_t src = order[start + (j % std::max<int64_t>(count, 1))];
+      const uint8_t* img = ds.images.data() + src * ss;
+      float* out = s->x.data() + j * ss;
+      const int64_t cc = ds.c;
+      for (int64_t p = 0; p < ss; ++p) {
+        const int64_t ch = p % cc;
+        out[p] = (float(img[p]) - ds.mean[ch]) * ds.inv_std[ch];
+      }
+      s->y[j] = ds.labels[src];
+    }
+    s->batch_idx = b;
+  }
+
+  void worker() {
+    for (;;) {
+      // Acquire a slot FIRST, then claim the next batch index. Claiming
+      // before holding a slot can deadlock: with more workers than slots,
+      // the slot-holders may all hold batches ahead of next_serve while
+      // the worker owning next_serve starves for a slot the consumer will
+      // never free. Claim-after-acquire bounds outstanding batch claims to
+      // the slot count, so the consumer's next batch always has a slot.
+      Slot* slot = nullptr;
+      {
+        std::unique_lock<std::mutex> l(mu);
+        for (;;) {
+          if (stopping) return;
+          if (next_claim.load() >= batches_per_epoch) return;
+          for (auto& s : slots) {
+            if (!s.ready && s.batch_idx == -1) {
+              s.batch_idx = -2;  // claimed
+              slot = &s;
+              break;
+            }
+          }
+          if (slot) break;
+          cv_free.wait(l);
+        }
+      }
+      int64_t b = next_claim.fetch_add(1);
+      if (b >= batches_per_epoch) {
+        std::lock_guard<std::mutex> l(mu);
+        slot->batch_idx = -1;  // release unused slot
+        cv_free.notify_all();
+        return;
+      }
+      fill(slot, b);
+      {
+        std::lock_guard<std::mutex> l(mu);
+        slot->ready = true;
+      }
+      cv_ready.notify_all();
+    }
+  }
+
+  void start_epoch(int64_t e, int64_t n_threads, int64_t queue_depth) {
+    stop();
+    {
+      std::lock_guard<std::mutex> l(mu);
+      stopping = false;
+    }
+    build_epoch(e);
+    next_claim = 0;
+    next_serve = 0;
+    slots.assign(std::max<int64_t>(queue_depth, 2), Slot{});
+    workers.clear();
+    for (int64_t i = 0; i < std::max<int64_t>(n_threads, 1); ++i)
+      workers.emplace_back([this] { worker(); });
+  }
+
+  // Returns 1 and fills (x, y) if a batch was produced; 0 at epoch end.
+  int next(float* x, int32_t* y) {
+    if (next_serve >= batches_per_epoch) return 0;
+    Slot* slot = nullptr;
+    {
+      std::unique_lock<std::mutex> l(mu);
+      for (;;) {
+        if (stopping) return -1;
+        for (auto& s : slots) {
+          if (s.ready && s.batch_idx == next_serve) {
+            slot = &s;
+            break;
+          }
+        }
+        if (slot) break;
+        cv_ready.wait(l);
+      }
+    }
+    std::memcpy(x, slot->x.data(), slot->x.size() * sizeof(float));
+    std::memcpy(y, slot->y.data(), slot->y.size() * sizeof(int32_t));
+    {
+      std::lock_guard<std::mutex> l(mu);
+      slot->ready = false;
+      slot->batch_idx = -1;
+      ++next_serve;
+    }
+    cv_free.notify_all();
+    return 1;
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+const char* gl_last_error() { return g_error.c_str(); }
+
+// kind: 0 = MNIST idx, 1 = CIFAR-10 binary
+void* gl_open(int kind, const char* dir, int train, int64_t batch,
+              int shuffle, int drop_last, uint64_t seed, int64_t rank,
+              int64_t world) {
+  auto* ld = new Loader();
+  bool ok = kind == 0 ? load_mnist(dir, train != 0, &ld->ds)
+                      : load_cifar10(dir, train != 0, &ld->ds);
+  if (!ok) {
+    delete ld;
+    return nullptr;
+  }
+  ld->batch = batch;
+  ld->shuffle = shuffle != 0;
+  ld->drop_last = drop_last != 0;
+  ld->seed = seed;
+  ld->rank = rank;
+  ld->world = world;
+  return ld;
+}
+
+// Register an in-memory uint8 NHWC dataset (for synthetic/custom data).
+void* gl_open_memory(const uint8_t* images, const int32_t* labels, int64_t n,
+                     int64_t h, int64_t w, int64_t c, const float* mean,
+                     const float* stdv, int64_t batch, int shuffle,
+                     int drop_last, uint64_t seed, int64_t rank,
+                     int64_t world) {
+  auto* ld = new Loader();
+  Dataset& ds = ld->ds;
+  ds.n = n;
+  ds.h = h;
+  ds.w = w;
+  ds.c = c;
+  ds.images.assign(images, images + n * h * w * c);
+  ds.labels.assign(labels, labels + n);
+  for (int i = 0; i < 3; ++i) {
+    ds.mean[i] = mean ? mean[i] * 255.0f : 0.0f;
+    ds.inv_std[i] = stdv ? 1.0f / (stdv[i] * 255.0f) : 1.0f / 255.0f;
+  }
+  ld->batch = batch;
+  ld->shuffle = shuffle != 0;
+  ld->drop_last = drop_last != 0;
+  ld->seed = seed;
+  ld->rank = rank;
+  ld->world = world;
+  return ld;
+}
+
+void gl_shape(void* h, int64_t* n, int64_t* hh, int64_t* ww, int64_t* cc) {
+  auto* ld = static_cast<Loader*>(h);
+  *n = ld->ds.n;
+  *hh = ld->ds.h;
+  *ww = ld->ds.w;
+  *cc = ld->ds.c;
+}
+
+int64_t gl_start_epoch(void* h, int64_t epoch, int64_t n_threads,
+                       int64_t queue_depth) {
+  auto* ld = static_cast<Loader*>(h);
+  ld->start_epoch(epoch, n_threads, queue_depth);
+  return ld->batches_per_epoch;
+}
+
+int gl_next(void* h, float* x, int32_t* y) {
+  return static_cast<Loader*>(h)->next(x, y);
+}
+
+void gl_close(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,224 @@
+"""Native data pipeline tests: C++ loader vs the Python reference contract.
+
+Covers the DistributedSampler-equivalent guarantees (deterministic epoch
+permutation from (seed, epoch); ranks partition each epoch disjointly),
+normalization correctness, prefetch-queue integrity under threading, and
+the file-format readers (MNIST idx written on the fly).
+"""
+
+import gzip
+import os
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+from grace_tpu.data import (MemoryDataset, NativeLoader, PythonLoader,
+                            make_loader, mnist_dataset, native_library_path)
+
+NATIVE = native_library_path()
+
+
+def _build_native():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run(["make", "-C", os.path.join(root, "native")], check=True,
+                   capture_output=True)
+
+
+if NATIVE is None:
+    try:
+        _build_native()
+        NATIVE = native_library_path()
+    except Exception:
+        NATIVE = None
+
+needs_native = pytest.mark.skipif(NATIVE is None,
+                                  reason="native library not built")
+
+
+def _dataset(n=100, h=8, w=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return MemoryDataset(
+        images=rng.integers(0, 256, (n, h, w, c), dtype=np.uint8),
+        labels=rng.integers(0, 10, n).astype(np.int32),
+        mean=(0.5, 0.4, 0.3), std=(0.2, 0.2, 0.2))
+
+
+def _collect(loader, epoch):
+    xs, ys = zip(*list(loader.epoch(epoch)))
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+class TestPythonLoader:
+    def test_batches_and_shapes(self):
+        ld = PythonLoader(_dataset(), batch_size=16, seed=1)
+        batches = list(ld.epoch(0))
+        assert len(batches) == 100 // 16
+        x, y = batches[0]
+        assert x.shape == (16, 8, 8, 3) and x.dtype == np.float32
+        assert y.shape == (16,) and y.dtype == np.int32
+
+    def test_deterministic_and_epoch_varying(self):
+        ld = PythonLoader(_dataset(), batch_size=16, seed=1)
+        x0, y0 = _collect(ld, 0)
+        x0b, y0b = _collect(ld, 0)
+        np.testing.assert_array_equal(y0, y0b)
+        _, y1 = _collect(ld, 1)
+        assert not np.array_equal(y0, y1)
+
+    def test_rank_sharding_disjoint(self):
+        ds = _dataset(n=96)
+        seen = []
+        for rank in range(4):
+            ld = PythonLoader(ds, batch_size=8, seed=3, rank=rank, world=4,
+                              shuffle=True)
+            _, y = _collect(ld, 0)
+            assert len(y) == 24
+            seen.append(y)
+        # Together the ranks consume each epoch exactly once.
+        all_labels = np.sort(np.concatenate(seen))
+        np.testing.assert_array_equal(all_labels, np.sort(ds.labels))
+
+    def test_normalization(self):
+        ds = _dataset()
+        ld = PythonLoader(ds, batch_size=10, shuffle=False, seed=0)
+        x, y = next(iter(ld.epoch(0)))
+        expect = (ds.images[:10].astype(np.float32)
+                  - np.array(ds.mean) * 255) / (np.array(ds.std) * 255)
+        np.testing.assert_allclose(x, expect, rtol=1e-5)
+
+
+@needs_native
+class TestNativeLoader:
+    def test_matches_python_contract(self):
+        """Same guarantees, not bit-identical order (different RNG)."""
+        ds = _dataset(n=128)
+        ld = NativeLoader(ds, batch_size=16, seed=5)
+        x, y = _collect(ld, 0)
+        assert x.shape == (128, 8, 8, 3)
+        # a permutation of the dataset
+        np.testing.assert_array_equal(np.sort(y), np.sort(ds.labels))
+        ld.close()
+
+    def test_deterministic_per_seed_epoch(self):
+        ds = _dataset(n=64)
+        a = NativeLoader(ds, batch_size=8, seed=7)
+        b = NativeLoader(ds, batch_size=8, seed=7)
+        _, ya = _collect(a, 3)
+        _, yb = _collect(b, 3)
+        np.testing.assert_array_equal(ya, yb)
+        _, yc = _collect(a, 4)
+        assert not np.array_equal(ya, yc)
+        a.close(), b.close()
+
+    def test_normalization_matches_python(self):
+        ds = _dataset(n=32)
+        nat = NativeLoader(ds, batch_size=8, shuffle=False, seed=0)
+        py = PythonLoader(ds, batch_size=8, shuffle=False, seed=0)
+        (xn, yn), (xp, yp) = next(iter(nat.epoch(0))), next(iter(py.epoch(0)))
+        np.testing.assert_array_equal(yn, yp)
+        np.testing.assert_allclose(xn, xp, rtol=1e-5)
+        nat.close()
+
+    def test_rank_sharding_disjoint(self):
+        ds = _dataset(n=96)
+        seen = []
+        for rank in range(4):
+            ld = NativeLoader(ds, batch_size=8, seed=3, rank=rank, world=4)
+            _, y = _collect(ld, 0)
+            seen.append(y)
+            ld.close()
+        np.testing.assert_array_equal(np.sort(np.concatenate(seen)),
+                                      np.sort(ds.labels))
+
+    def test_threaded_queue_integrity(self):
+        """Many threads, small queue: batches must still arrive in order
+        with every sample exactly once."""
+        ds = _dataset(n=1024, h=4, w=4, c=1)
+        ld = NativeLoader(MemoryDataset(ds.images, np.arange(1024,
+                                                            dtype=np.int32)),
+                          batch_size=32, seed=9, n_threads=8, queue_depth=3)
+        for epoch in range(3):
+            _, y = _collect(ld, epoch)
+            np.testing.assert_array_equal(np.sort(y), np.arange(1024))
+        ld.close()
+
+    def test_short_final_batch_wraps(self):
+        ds = _dataset(n=20)
+        ld = NativeLoader(ds, batch_size=8, drop_last=False, shuffle=False,
+                          seed=0)
+        batches = list(ld.epoch(0))
+        assert len(batches) == 3
+        assert batches[-1][0].shape == (8, 8, 8, 3)
+        ld.close()
+
+    def test_mnist_idx_reader(self, tmp_path):
+        """Write idx files, read through the NATIVE file loader via ctypes."""
+        import ctypes
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (10, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, 10, dtype=np.uint8)
+        with gzip.open(tmp_path / "train-images-idx3-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 10, 28, 28))
+            f.write(imgs.tobytes())
+        with open(tmp_path / "train-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 2049, 10))
+            f.write(labels.tobytes())
+
+        lib = ctypes.CDLL(NATIVE)
+        lib.gl_open.restype = ctypes.c_void_p
+        lib.gl_open.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                                ctypes.c_uint64, ctypes.c_int64,
+                                ctypes.c_int64]
+        lib.gl_start_epoch.restype = ctypes.c_int64
+        lib.gl_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_int64, ctypes.c_int64]
+        lib.gl_next.restype = ctypes.c_int
+        lib.gl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_void_p]
+        lib.gl_close.argtypes = [ctypes.c_void_p]
+        h = lib.gl_open(0, str(tmp_path).encode(), 1, 5, 0, 1, 0, 0, 1)
+        assert h
+        nb = lib.gl_start_epoch(h, 0, 2, 2)
+        assert nb == 2
+        x = np.empty((5, 28, 28, 1), np.float32)
+        y = np.empty((5,), np.int32)
+        assert lib.gl_next(h, x.ctypes.data_as(ctypes.c_void_p),
+                           y.ctypes.data_as(ctypes.c_void_p)) == 1
+        np.testing.assert_array_equal(y, labels[:5].astype(np.int32))
+        expect = (imgs[:5, :, :, None].astype(np.float32)
+                  - 0.1307 * 255) / (0.3081 * 255)
+        np.testing.assert_allclose(x, expect, rtol=1e-5)
+        lib.gl_close(h)
+
+    def test_make_loader_prefers_native(self):
+        ld = make_loader(_dataset(n=16), batch_size=8)
+        assert isinstance(ld, NativeLoader)
+
+
+class TestDatasetValidation:
+    def test_bad_dtype_rejected(self):
+        with pytest.raises(ValueError, match="uint8"):
+            MemoryDataset(np.zeros((4, 2, 2, 1), np.float32),
+                          np.zeros(4, np.int32))
+
+    def test_length_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="mismatch"):
+            MemoryDataset(np.zeros((4, 2, 2, 1), np.uint8),
+                          np.zeros(3, np.int32))
+
+    def test_mnist_dataset_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (6, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, 6, dtype=np.uint8)
+        with open(tmp_path / "t10k-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 6, 28, 28))
+            f.write(imgs.tobytes())
+        with open(tmp_path / "t10k-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 2049, 6))
+            f.write(labels.tobytes())
+        ds = mnist_dataset(str(tmp_path), train=False)
+        assert ds.images.shape == (6, 28, 28, 1)
+        np.testing.assert_array_equal(ds.labels, labels.astype(np.int32))
