@@ -7,82 +7,100 @@ k=1% + residual memory should reach >=90% of the uncompressed-allreduce
 throughput. Runs the full GRACE pipeline (compensate -> compress -> update ->
 exchange) on the available device mesh.
 
-Prints ONE JSON line:
+Always prints ONE JSON line as the last stdout line:
   {"metric": "resnet50_topk1pct_imgs_per_sec", "value": ..., "unit":
-   "imgs/sec", "vs_baseline": <compressed/uncompressed throughput ratio>}
+   "imgs/sec", "vs_baseline": <compressed/uncompressed ratio>, "platform": ...}
+
+Failure engineering (round-1 postmortem: the TPU tunnel backend hung >9 min
+in init and the bench emitted nothing): the measurement runs in a worker
+subprocess under a hard timeout; the orchestrator first probes backend init
+separately, retries once, and on TPU failure falls back to an 8-device
+simulated-CPU mesh so a real number is captured either way. Stage
+diagnostics go to stderr; stdout carries only the final JSON line.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-
-def _build_step(grace_params, mesh, num_classes, sgd_lr=1e-3):
-    from grace_tpu import grace_from_params
-    from grace_tpu.models import resnet
-    from grace_tpu.train import (init_stateful_train_state,
-                                 make_stateful_train_step)
-
-    grace = grace_from_params(grace_params)
-    optimizer = optax.chain(grace.transform(seed=0), optax.sgd(sgd_lr))
-
-    def loss_fn(params, mstate, batch):
-        x, y = batch
-        logits, new_mstate = resnet.apply(params, mstate, x.astype(jnp.bfloat16),
-                                          train=True)
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-        return loss.mean(), new_mstate
-
-    step = make_stateful_train_step(loss_fn, optimizer, mesh)
-    params, mstate = resnet.init(jax.random.key(0), depth=50,
-                                 num_classes=num_classes)
-    ts = init_stateful_train_state(params, mstate, optimizer, mesh)
-    return step, ts
+PROBE_TIMEOUTS_S = (180, 420)  # healthy tunnel inits in seconds; second
+                               # probe gets a long leash for slow cold init
+WORKER_TIMEOUT_S = 1200        # full bench incl. first compile (~20-40s/fn)
 
 
-def _throughput(step, ts, batch, n_batches, warmup=2):
-    """Fetch-bounded timing window.
+# --------------------------------------------------------------------------
+# Worker: the actual measurement (runs in a subprocess)
+# --------------------------------------------------------------------------
 
-    On remote-tunneled platforms (axon) `jax.block_until_ready` does NOT
-    wait for device execution — only a value fetch truly synchronizes. So:
-    drain the queue with a fetch, time n dependent steps bounded by a final
-    fetch, and subtract the measured fetch round-trip so the window covers
-    device execution, not tunnel latency. Returns (imgs/sec, final state) —
-    the step donates its inputs, so callers must thread the live state.
-    """
-    import time
+def _worker(platform: str) -> None:
+    import jax
 
-    for _ in range(warmup):
-        ts, loss = step(ts, batch)
-    float(loss)                      # drain: all queued work done
-    # RTT on a fresh trivial computation — re-fetching `loss` would hit
-    # jax's cached host copy and measure nothing.
-    t0 = time.perf_counter()
-    float(loss + 1.0)
-    rtt = time.perf_counter() - t0   # tiny-dispatch + fetch round-trip
+    if platform == "cpu":
+        # Same dance as tests/conftest.py: the image's sitecustomize latches
+        # jax onto the TPU tunnel, so env vars alone are not enough.
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
 
-    t0 = time.perf_counter()
-    for _ in range(n_batches):
-        ts, loss = step(ts, batch)
-    float(loss)                      # bounds the window: steps are dependent
-    dt = max(1e-9, time.perf_counter() - t0 - rtt)
-    return batch[1].shape[0] * n_batches / dt, ts
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
 
-
-def main():
     from grace_tpu.parallel import batch_sharded, data_parallel_mesh
 
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
+    if platform == "tpu" and not on_tpu:
+        raise RuntimeError(f"wanted tpu, got {devices[0].platform}")
     mesh = data_parallel_mesh(devices)
 
+    def build_step(grace_params, num_classes):
+        from grace_tpu import grace_from_params
+        from grace_tpu.models import resnet
+        from grace_tpu.train import (init_stateful_train_state,
+                                     make_stateful_train_step)
+
+        grace = grace_from_params(grace_params)
+        optimizer = optax.chain(grace.transform(seed=0), optax.sgd(1e-3))
+
+        def loss_fn(params, mstate, batch):
+            x, y = batch
+            logits, new_mstate = resnet.apply(
+                params, mstate, x.astype(jnp.bfloat16), train=True)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return loss.mean(), new_mstate
+
+        step = make_stateful_train_step(loss_fn, optimizer, mesh)
+        params, mstate = resnet.init(jax.random.key(0), depth=50,
+                                     num_classes=num_classes)
+        ts = init_stateful_train_state(params, mstate, optimizer, mesh)
+        return step, ts
+
+    def throughput(step, ts, batch, n_batches, warmup=2):
+        # Fetch-bounded timing: on the axon tunnel block_until_ready does not
+        # wait for device execution — only a value fetch synchronizes. Drain
+        # with a fetch, time n dependent steps bounded by a final fetch, and
+        # subtract the measured fetch RTT (~65 ms) so the window covers
+        # device execution, not tunnel latency.
+        for _ in range(warmup):
+            ts, loss = step(ts, batch)
+        float(loss)
+        t0 = time.perf_counter()
+        float(loss + 1.0)            # fresh tiny dispatch: cache-miss fetch
+        rtt = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            ts, loss = step(ts, batch)
+        float(loss)
+        dt = max(1e-9, time.perf_counter() - t0 - rtt)
+        return batch[1].shape[0] * n_batches / dt, ts
+
     # Reference protocol: bs=32 per worker, ImageNet shapes on accelerators;
-    # CPU fallback shrinks shapes so the bench stays runnable anywhere.
+    # the CPU fallback shrinks shapes so a number lands anywhere.
     per_device_bs = 32 if on_tpu else 4
     image_hw = 224 if on_tpu else 64
     n_batches = 30 if on_tpu else 3
@@ -98,28 +116,117 @@ def main():
 
     def run(grace_params):
         # best-of-N to damp chip/host jitter (~8% run-to-run on the tunnel)
-        step, ts = _build_step(grace_params, mesh, num_classes)
+        step, ts = build_step(grace_params, num_classes)
         best = 0.0
         for _ in range(repeats):
-            tput, ts = _throughput(step, ts, batch, n_batches, warmup=4)
+            tput, ts = throughput(step, ts, batch, n_batches, warmup=4)
             best = max(best, tput)
         return best
 
+    print(f"[bench] mesh: {len(devices)}x {devices[0].platform}",
+          file=sys.stderr, flush=True)
     # Both sides get the fusion buffer — Horovod fuses the uncompressed
     # baseline too, so a like-for-like ratio must as well.
     baseline = run({"compressor": "none", "memory": "none",
                     "communicator": "allreduce", "fusion": "flat"})
+    print(f"[bench] baseline uncompressed: {baseline:.2f} imgs/sec",
+          file=sys.stderr, flush=True)
     compressed = run({"compressor": "topk", "compress_ratio": 0.01,
                       "memory": "residual", "communicator": "allgather",
                       "fusion": "flat"})
+    print(f"[bench] topk-1%: {compressed:.2f} imgs/sec",
+          file=sys.stderr, flush=True)
 
     print(json.dumps({
         "metric": "resnet50_topk1pct_imgs_per_sec",
         "value": round(compressed, 2),
         "unit": "imgs/sec",
         "vs_baseline": round(compressed / baseline, 4),
-    }))
+        "platform": devices[0].platform,
+    }), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Orchestrator: probe -> run -> retry -> CPU fallback; always emit JSON
+# --------------------------------------------------------------------------
+
+def _run_sub(args, timeout, extra_env=None):
+    """Run a python subprocess; return (rc, stdout, stderr|'timeout')."""
+    env = dict(os.environ, **(extra_env or {}))
+    try:
+        p = subprocess.run([sys.executable, *args], capture_output=True,
+                           text=True, timeout=timeout, env=env)
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return 124, out, f"timeout after {timeout}s"
+
+
+def _last_json_line(stdout: str):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+                if "metric" in obj:
+                    return obj
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _probe_tpu(timeout: float) -> bool:
+    rc, out, err = _run_sub(
+        ["-c", "import jax; d = jax.devices(); "
+               "print(d[0].platform, len(d))"],
+        timeout)
+    ok = rc == 0 and out.strip().startswith("tpu")
+    print(f"[bench] tpu probe: rc={rc} out={out.strip()!r} "
+          f"err_tail={err[-200:]!r}", file=sys.stderr, flush=True)
+    return ok
+
+
+def main() -> None:
+    stages = []
+    here = os.path.abspath(__file__)
+
+    for attempt, probe_timeout in enumerate(PROBE_TIMEOUTS_S, start=1):
+        if not _probe_tpu(probe_timeout):
+            stages.append({"stage": "backend_init", "attempt": attempt,
+                           "error": "tpu probe failed/timed out"})
+            continue
+        rc, out, err = _run_sub([here, "--_worker", "tpu"], WORKER_TIMEOUT_S)
+        result = _last_json_line(out)
+        if rc == 0 and result:
+            result["stages"] = stages
+            print(json.dumps(result), flush=True)
+            return
+        stages.append({"stage": "tpu_bench", "attempt": attempt, "rc": rc,
+                       "error": err[-500:]})
+        print(f"[bench] tpu attempt {attempt} failed rc={rc}: {err[-500:]}",
+              file=sys.stderr, flush=True)
+
+    print("[bench] falling back to 8-device simulated-CPU mesh",
+          file=sys.stderr, flush=True)
+    rc, out, err = _run_sub([here, "--_worker", "cpu"], WORKER_TIMEOUT_S)
+    result = _last_json_line(out)
+    if rc == 0 and result:
+        result["stages"] = stages
+        print(json.dumps(result), flush=True)
+        return
+    stages.append({"stage": "cpu_bench", "rc": rc, "error": err[-500:]})
+    print(json.dumps({
+        "metric": "resnet50_topk1pct_imgs_per_sec",
+        "value": None, "unit": "imgs/sec", "vs_baseline": None,
+        "stages": stages,
+    }), flush=True)
+    sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--_worker":
+        _worker(sys.argv[2])
+    else:
+        main()

@@ -1,0 +1,95 @@
+"""Keras MNIST with DistributedOptimizer — the reference's Keras path.
+
+TPU-native port of examples/tensorflow/tensorflow2_keras_mnist.py (:60-89):
+`model.fit` with a grace-wrapped Keras optimizer (BASELINE.json config 5 —
+the TF 1-bit/signSGD path — is `--compressor onebit --memory residual` or
+`--compressor signsgd`) plus the reference's callback set: initial-state
+broadcast, cross-rank metric averaging, and LR warmup.
+
+Run (simulated 8-device mesh; TF stays on CPU):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/tf2_keras_mnist.py --epochs 3 --compressor onebit \\
+        --memory residual --communicator allgather
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import common
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    common.add_grace_args(parser)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--warmup-epochs", type=int, default=3)
+    parser.add_argument("--train-size", type=int, default=8192)
+    parser.add_argument("--data-dir", default=None,
+                        help="MNIST idx directory (default: synthetic)")
+    parser.add_argument("--ckpt", default=None,
+                        help="save the trained model here (.keras); reload "
+                             "with grace_tpu.interop.keras.load_model")
+    args = parser.parse_args()
+
+    import jax
+    import keras
+
+    from grace_tpu import grace_from_params
+    from grace_tpu.interop.keras import (BroadcastGlobalVariablesCallback,
+                                         DistributedOptimizer,
+                                         LearningRateWarmupCallback,
+                                         MetricAverageCallback)
+    from grace_tpu.parallel import data_parallel_mesh, initialize_distributed
+    from grace_tpu.utils import rank_zero_print
+
+    initialize_distributed()
+    mesh = data_parallel_mesh()
+    world = mesh.devices.size
+    grc = grace_from_params(common.grace_params_from_args(args))
+
+    if args.data_dir:
+        x, y = common.load_mnist_idx(args.data_dir, train=True)
+    else:
+        x, y = common.synthetic_mnist(args.train_size, seed=args.seed)
+
+    keras.utils.set_random_seed(args.seed)
+    model = keras.Sequential([
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Conv2D(64, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    opt = DistributedOptimizer(keras.optimizers.SGD(args.lr), grc,
+                               mesh=mesh, seed=args.seed)
+    model.compile(
+        optimizer=opt,
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"])
+
+    callbacks = [
+        BroadcastGlobalVariablesCallback(root_rank=0),
+        MetricAverageCallback(),
+        LearningRateWarmupCallback(world_size=world,
+                                   warmup_epochs=args.warmup_epochs,
+                                   verbose=jax.process_index() == 0),
+    ]
+    model.fit(x.astype(np.float32), y.astype(np.int32),
+              batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks,
+              verbose=2 if jax.process_index() == 0 else 0)
+
+    if args.ckpt and jax.process_index() == 0:
+        model.save(args.ckpt)
+        rank_zero_print(f"model saved to {args.ckpt}")
+
+
+if __name__ == "__main__":
+    main()

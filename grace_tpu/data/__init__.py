@@ -117,6 +117,16 @@ def cifar10_dataset(data_dir: str, train: bool = True) -> MemoryDataset:
 
 
 class _LoaderBase:
+    """Loader contract shared by the native and Python implementations.
+
+    With ``drop_last=False`` the short final batch is filled by wrapping
+    (duplicating) samples from the front of the batch so every batch has a
+    static shape (an XLA requirement). This double-counts those samples, so
+    it is unsuitable for *exact* evaluation metrics — for eval, truncate the
+    dataset to a batch multiple (examples/mnist_lenet.py does this) or weight
+    the final batch by its true ``count/batch_size``.
+    """
+
     batch_size: int
     shape: Tuple[int, int, int]
 

@@ -1,0 +1,180 @@
+"""Keras frontend: DistributedOptimizer + callbacks + grace-aware load_model.
+
+Analog of the reference's Keras glue (patch_files/horovod/_keras/__init__.py:
+20-80 `create_distributed_optimizer`, patch_files/horovod/tensorflow/keras/
+__init__.py:41-63 `DistributedOptimizer`, :121-150 `load_model`) and the
+callbacks its Keras example drives (examples/tensorflow/
+tensorflow2_keras_mnist.py:69-89: BroadcastGlobalVariablesCallback,
+MetricAverageCallback, LearningRateWarmupCallback).
+
+Design differences, deliberate:
+
+* The reference intercepts the TF1-era ``get_gradients``; Keras 3 optimizers
+  funnel every update through ``apply`` (``apply_gradients`` delegates to
+  it), so that is the single hook point here.
+* The compressed exchange itself is the same fused JAX/XLA program as every
+  other frontend (one ``tf.numpy_function`` callout over a flat buffer, see
+  grace_tpu/interop/tensorflow.py) — usable under ``model.fit`` graph mode.
+* ``load_model`` maps optimizer class names to grace-wrapped subclasses via
+  ``custom_objects``, exactly the reference's trick, so a checkpoint saved
+  with a plain optimizer deserializes straight into a distributed one.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from grace_tpu.helper import Grace
+from grace_tpu.interop.tensorflow import (TFExchanger, _broadcast_array,
+                                          broadcast_variables)
+
+__all__ = ["DistributedOptimizer", "load_model",
+           "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+           "LearningRateWarmupCallback"]
+
+
+def _require_keras():
+    try:
+        import keras
+        return keras
+    except ImportError as e:  # pragma: no cover - image ships keras
+        raise ImportError(
+            "grace_tpu.interop.keras requires the optional keras/tensorflow "
+            "dependency") from e
+
+
+def _distributed_subclass(base_cls, grace: Grace, mesh, seed: int):
+    """Subclass a Keras optimizer class so ``apply`` first routes gradients
+    through the compressed exchange (reference: _keras/__init__.py:53-57
+    overriding get_gradients)."""
+
+    class _Distributed(base_cls):
+        _grace_exchanger = None
+
+        def apply(self, grads, trainable_variables=None):
+            if self._grace_exchanger is None:
+                type(self)._grace_exchanger = TFExchanger(grace, mesh=mesh,
+                                                          seed=seed)
+            grads = self._grace_exchanger.exchange(list(grads))
+            return super().apply(grads, trainable_variables)
+
+    _Distributed.__name__ = base_cls.__name__
+    _Distributed.__qualname__ = f"Distributed{base_cls.__name__}"
+    return _Distributed
+
+
+def DistributedOptimizer(optimizer, grace: Grace, mesh=None, seed: int = 0):
+    """Wrap a built keras optimizer in the grace exchange.
+
+    Returns a new optimizer of a dynamic subclass of ``type(optimizer)``
+    (reference: tensorflow/keras/__init__.py:41-63), reconstructed from
+    ``optimizer.get_config()`` — hyperparameters, schedules and all.
+    """
+    keras = _require_keras()
+    if not isinstance(optimizer, keras.optimizers.Optimizer):
+        raise TypeError(f"expected a keras optimizer, got {type(optimizer)}")
+    cls = _distributed_subclass(type(optimizer), grace, mesh, seed)
+    return cls.from_config(optimizer.get_config())
+
+
+def load_model(filepath, grace: Grace, mesh=None, seed: int = 0, **kwargs):
+    """``keras.saving.load_model`` that revives the saved optimizer as a
+    grace DistributedOptimizer (reference: tensorflow/keras/__init__.py:
+    121-150).
+
+    The reference intercepts deserialization via ``custom_objects``; Keras 3
+    only consults that table for custom-registered classes, so instead the
+    model is loaded normally and its optimizer is wrapped in place, with all
+    restored slot state (iterations, momenta, ...) transferred so a resumed
+    run continues exactly where the checkpoint left off."""
+    keras = _require_keras()
+    model = keras.saving.load_model(filepath, **kwargs)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        wrapped = DistributedOptimizer(opt, grace, mesh=mesh, seed=seed)
+        if getattr(opt, "built", False):
+            wrapped.build(model.trainable_variables)
+            for src, dst in zip(opt.variables, wrapped.variables):
+                dst.assign(src)
+        model.optimizer = wrapped
+    return model
+
+
+# ---------------------------------------------------------------------------
+# Callbacks (reference: examples/tensorflow/tensorflow2_keras_mnist.py:69-89)
+# ---------------------------------------------------------------------------
+
+def _callback_base():
+    return _require_keras().callbacks.Callback
+
+
+class BroadcastGlobalVariablesCallback(_callback_base()):
+    """Sync model + optimizer variables from ``root_rank`` before training so
+    all processes start from identical state."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_batch_end(self, batch, logs=None):
+        # After the first step, like the reference's tape example
+        # (tensorflow2_mnist.py:82-84): variables (incl. lazily created
+        # optimizer slots) all exist by then.
+        if not self._done:
+            broadcast_variables(self.model.variables, self.root_rank)
+            if self.model.optimizer is not None:
+                broadcast_variables(self.model.optimizer.variables,
+                                    self.root_rank)
+            self._done = True
+
+
+class MetricAverageCallback(_callback_base()):
+    """Average epoch-end metrics over all processes (reference example line
+    79: metrics computed on each worker's shard are only meaningful
+    averaged). Single-process: no-op."""
+
+    def _average(self, logs):
+        if not logs or jax.process_count() == 1:
+            return logs
+        from jax.experimental import multihost_utils
+        keys = sorted(k for k, v in logs.items()
+                      if isinstance(v, (int, float, np.floating, np.integer)))
+        if not keys:
+            return logs
+        local = np.asarray([float(logs[k]) for k in keys], np.float32)
+        gathered = np.asarray(multihost_utils.process_allgather(local))
+        for i, k in enumerate(keys):
+            logs[k] = float(gathered[:, i].mean())
+        return logs
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average(logs)
+
+
+class LearningRateWarmupCallback(_callback_base()):
+    """Linearly ramp the learning rate from its configured value to
+    ``value x world_size`` over ``warmup_epochs`` (the large-batch warmup of
+    Goyal et al., as shipped by the reference example's callback list,
+    tensorflow2_keras_mnist.py:80-89), then hold the scaled rate."""
+
+    def __init__(self, world_size: int, warmup_epochs: int = 5,
+                 verbose: bool = False):
+        super().__init__()
+        self.world_size = int(world_size)
+        self.warmup_epochs = int(warmup_epochs)
+        self.verbose = verbose
+        self._base_lr = None
+
+    def on_train_begin(self, logs=None):
+        self._base_lr = float(
+            np.asarray(self.model.optimizer.learning_rate))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        progress = min(1.0, (epoch + 1) / max(1, self.warmup_epochs))
+        factor = 1.0 + (self.world_size - 1.0) * progress
+        lr = self._base_lr * factor
+        self.model.optimizer.learning_rate = lr
+        if self.verbose:
+            print(f"LearningRateWarmup: epoch {epoch}: lr -> {lr:.6g}")

@@ -3,45 +3,102 @@
 Analog of the reference's patched `hvd.DistributedGradientTape(tape, grace)`
 (patch_files/horovod/tensorflow/__init__.py:314-365): wrap a `tf.GradientTape`
 so `tape.gradient(...)` returns globally aggregated, compressed-exchanged
-gradients. The mechanism is the same numpy bridge as the torch frontend —
-TF is an optional dependency (import-gated; this image ships without it).
+gradients. TF is an optional dependency — everything here is import-gated,
+but when TF is installed (as in this image) the full path is live and tested
+(tests/test_interop.py, examples/tf2_mnist.py).
 
-Note the execution model difference from the reference: the TF2 patch runs
-GRACE ops *inside* the TF graph (SURVEY.md §3.2); here the exchange runs in
-JAX/XLA on the TPU mesh and the TF side only sees numpy values, so this
-wrapper must be used in eager mode (no @tf.function around the exchange).
+Execution model: the reference's TF2 patch runs GRACE ops *inside* the TF
+graph (SURVEY.md §3.2). Here the compressed exchange is a jitted JAX/XLA
+program on the TPU mesh; it embeds into TF graphs as a single host callout
+(`tf.numpy_function`) over one fused flat gradient buffer — so the wrapper
+works both eagerly and inside `@tf.function` / `model.fit`. The per-tensor
+graph-op plumbing of the reference collapses into one bucketed exchange,
+exactly like the torch frontend (grace_tpu/interop/torch.py).
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import numpy as np
 
 from grace_tpu.helper import Grace
 
-__all__ = ["DistributedGradientTape"]
+__all__ = ["DistributedGradientTape", "TFExchanger", "broadcast_variables"]
+
+
+def _require_tf():
+    try:
+        import tensorflow as tf
+        return tf
+    except ImportError as e:  # pragma: no cover - image ships TF
+        raise ImportError(
+            "grace_tpu.interop.tensorflow requires the optional tensorflow "
+            "dependency") from e
+
+
+class TFExchanger:
+    """Embeds the jitted grace exchange into TF graphs.
+
+    Flattens a gradient list into one fp32 buffer in-graph, routes it through
+    a lazily constructed :class:`GraceBridge` via ``tf.numpy_function`` (a
+    stateful host callout, legal under ``@tf.function``), and splits the
+    aggregated result back to the original shapes/dtypes. ``IndexedSlices``
+    are densified first — same behavior as the reference's dense allreduce
+    branch (patch_files/horovod/tensorflow/__init__.py:37-77).
+    """
+
+    def __init__(self, grace: Grace, mesh=None, seed: int = 0):
+        self._grace = grace
+        self._mesh = mesh
+        self._seed = seed
+        self._bridge = None
+
+    def _host_exchange(self, flat: np.ndarray) -> np.ndarray:
+        from grace_tpu.interop.bridge import GraceBridge
+        if self._bridge is None or self._bridge.n != flat.size:
+            self._bridge = GraceBridge(self._grace, n=flat.size,
+                                       mesh=self._mesh, seed=self._seed)
+        return np.asarray(self._bridge.exchange(flat), np.float32)
+
+    def exchange(self, grads):
+        """list of tf.Tensor/IndexedSlices/None -> same-structure aggregated."""
+        tf = _require_tf()
+        dense = [None if g is None else tf.convert_to_tensor(g)
+                 for g in grads]
+        live = [g for g in dense if g is not None]
+        if not live:
+            return list(grads)
+        sizes = [int(np.prod(g.shape)) for g in live]
+        n = int(sum(sizes))
+        flat = tf.concat(
+            [tf.reshape(tf.cast(g, tf.float32), [-1]) for g in live], axis=0)
+        out = tf.numpy_function(self._host_exchange, [flat], tf.float32,
+                                stateful=True)
+        out = tf.ensure_shape(out, [n])
+        pieces = tf.split(out, sizes)
+        results, it = [], iter(zip(live, pieces))
+        for g in dense:
+            if g is None:
+                results.append(None)
+            else:
+                orig, piece = next(it)
+                results.append(tf.cast(tf.reshape(piece, orig.shape),
+                                       orig.dtype))
+        return results
 
 
 def DistributedGradientTape(gradtape, grace: Grace, mesh=None, seed: int = 0):
     """Wrap ``tf.GradientTape`` so ``gradient()`` returns aggregated grads."""
-    try:
-        import tensorflow as tf  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "grace_tpu.interop.tensorflow requires the optional tensorflow "
-            "dependency, which is not installed in this environment."
-        ) from e
-
-    from grace_tpu.interop.bridge import GraceBridge
+    _require_tf()
+    exchanger = TFExchanger(grace, mesh=mesh, seed=seed)
 
     class _Wrapped(type(gradtape)):
         def __init__(self):
             self.__dict__.update(gradtape.__dict__)
             self._grace = grace
-            self._bridge = None
-            self._mesh = mesh
-            self._seed = seed
+            self._exchanger = exchanger
 
         def gradient(self, target, sources, output_gradients=None):
             # tf.GradientTape.gradient mirrors the structure of `sources`:
@@ -50,29 +107,31 @@ def DistributedGradientTape(gradtape, grace: Grace, mesh=None, seed: int = 0):
             grads = super().gradient(target, sources, output_gradients)
             if single:
                 grads = [grads]
-            flats, shapes, sizes, dtypes = [], [], [], []
-            for g in grads:
-                arr = np.zeros(0, np.float32) if g is None else \
-                    np.asarray(tf.convert_to_tensor(g), np.float32).ravel()
-                flats.append(arr)
-                shapes.append(None if g is None else tuple(g.shape))
-                dtypes.append(None if g is None else g.dtype)
-                sizes.append(arr.size)
-            flat = np.concatenate(flats) if flats else np.zeros(0, np.float32)
-            if self._bridge is None:
-                self._bridge = GraceBridge(self._grace, n=flat.size,
-                                           mesh=self._mesh, seed=self._seed)
-            out = np.asarray(self._bridge.exchange(flat))
-            results, off = [], 0
-            for shape, size, dtype in zip(shapes, sizes, dtypes):
-                if shape is None:
-                    results.append(None)
-                else:
-                    results.append(tf.constant(
-                        out[off:off + size].reshape(shape), dtype=dtype))
-                off += size
+            results = self._exchanger.exchange(list(grads))
             return results[0] if single else results
 
     wrapped = _Wrapped.__new__(_Wrapped)
     _Wrapped.__init__(wrapped)
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Init-time variable sync (reference: BroadcastGlobalVariablesHook /
+# examples/tensorflow/tensorflow2_mnist.py:82-84)
+# ---------------------------------------------------------------------------
+
+def _broadcast_array(x: np.ndarray, root_rank: int) -> np.ndarray:
+    from jax.experimental import multihost_utils
+    if jax.process_count() == 1:
+        return x
+    return np.asarray(multihost_utils.broadcast_one_to_all(
+        x, is_source=jax.process_index() == root_rank))
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Broadcast TF/Keras variables from ``root_rank`` to all processes,
+    in place. Single-process: no-op (already consistent)."""
+    _require_tf()
+    for v in variables:
+        synced = _broadcast_array(np.asarray(v), root_rank)
+        v.assign(synced.reshape(v.shape))

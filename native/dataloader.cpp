@@ -17,7 +17,7 @@
 //     rank r takes the strided slice r::world (the DistributedSampler
 //     contract, so ranks partition each epoch disjointly).
 //   * Pipeline: N worker threads claim batch indices from an atomic
-//     counter, normalize ((x/255 - mean)/std) into preallocated slots of a
+//     counter, normalize ((x - mean·255)/(std·255), same fp32 op order as MemoryDataset.normalize so results are bit-identical) into preallocated slots of a
 //     bounded ring, and a consumer thread hands slots to the caller in
 //     batch order. Backpressure via condition variables, capacity fixed at
 //     queue_depth batches.
@@ -86,7 +86,7 @@ struct Dataset {
   std::vector<int32_t> labels;  // n
   int64_t n = 0, h = 0, w = 0, c = 0;
   float mean[3] = {0, 0, 0};
-  float inv_std[3] = {1, 1, 1};
+  float stddiv[3] = {255, 255, 255};
 
   int64_t sample_size() const { return h * w * c; }
 };
@@ -137,7 +137,7 @@ bool load_mnist(const std::string& dir, bool train, Dataset* ds) {
   ds->labels.resize(ds->n);
   for (int64_t i = 0; i < ds->n; ++i) ds->labels[i] = lb[8 + i];
   ds->mean[0] = 0.1307f * 255.0f;
-  ds->inv_std[0] = 1.0f / (0.3081f * 255.0f);
+  ds->stddiv[0] = 0.3081f * 255.0f;
   return true;
 }
 
@@ -176,7 +176,7 @@ bool load_cifar10(const std::string& dir, bool train, Dataset* ds) {
   const float stdv[3] = {0.2471f, 0.2435f, 0.2616f};
   for (int i = 0; i < 3; ++i) {
     ds->mean[i] = mean[i] * 255.0f;
-    ds->inv_std[i] = 1.0f / (stdv[i] * 255.0f);
+    ds->stddiv[i] = stdv[i] * 255.0f;
   }
   return true;
 }
@@ -262,7 +262,7 @@ struct Loader {
       const int64_t cc = ds.c;
       for (int64_t p = 0; p < ss; ++p) {
         const int64_t ch = p % cc;
-        out[p] = (float(img[p]) - ds.mean[ch]) * ds.inv_std[ch];
+        out[p] = (float(img[p]) - ds.mean[ch]) / ds.stddiv[ch];
       }
       s->y[j] = ds.labels[src];
     }
@@ -402,7 +402,7 @@ void* gl_open_memory(const uint8_t* images, const int32_t* labels, int64_t n,
   ds.labels.assign(labels, labels + n);
   for (int i = 0; i < 3; ++i) {
     ds.mean[i] = mean ? mean[i] * 255.0f : 0.0f;
-    ds.inv_std[i] = stdv ? 1.0f / (stdv[i] * 255.0f) : 1.0f / 255.0f;
+    ds.stddiv[i] = stdv ? stdv[i] * 255.0f : 255.0f;
   }
   ld->batch = batch;
   ld->shuffle = shuffle != 0;

@@ -86,7 +86,7 @@ class TestPythonLoader:
         x, y = next(iter(ld.epoch(0)))
         expect = (ds.images[:10].astype(np.float32)
                   - np.array(ds.mean) * 255) / (np.array(ds.std) * 255)
-        np.testing.assert_allclose(x, expect, rtol=1e-5)
+        np.testing.assert_allclose(x, expect, rtol=1e-5, atol=1e-6)
 
 
 @needs_native
@@ -118,7 +118,7 @@ class TestNativeLoader:
         py = PythonLoader(ds, batch_size=8, shuffle=False, seed=0)
         (xn, yn), (xp, yp) = next(iter(nat.epoch(0))), next(iter(py.epoch(0)))
         np.testing.assert_array_equal(yn, yp)
-        np.testing.assert_allclose(xn, xp, rtol=1e-5)
+        np.testing.assert_allclose(xn, xp, rtol=1e-5, atol=1e-6)
         nat.close()
 
     def test_rank_sharding_disjoint(self):
@@ -190,7 +190,7 @@ class TestNativeLoader:
         np.testing.assert_array_equal(y, labels[:5].astype(np.int32))
         expect = (imgs[:5, :, :, None].astype(np.float32)
                   - 0.1307 * 255) / (0.3081 * 255)
-        np.testing.assert_allclose(x, expect, rtol=1e-5)
+        np.testing.assert_allclose(x, expect, rtol=1e-5, atol=1e-6)
         lib.gl_close(h)
 
     def test_make_loader_prefers_native(self):
