@@ -13,6 +13,14 @@ import argparse
 import gzip
 import os
 import struct
+import sys
+
+# Examples run as scripts (`python examples/foo.py`), where sys.path[0] is
+# examples/ — put the repo root first so `import grace_tpu` resolves without
+# an install step. Examples import this module before grace_tpu.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import numpy as np
 

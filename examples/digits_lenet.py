@@ -1,0 +1,110 @@
+"""Real-data convergence evidence: LeNet on the UCI digits dataset.
+
+The image has no network access and no MNIST/CIFAR files on disk, so the
+committed convergence run (VERDICT round-1 item 5) uses the one real image
+dataset that ships inside the environment: scikit-learn's bundled UCI
+handwritten digits (1,797 scanned 8x8 digits, upscaled to LeNet's 28x28).
+Same training harness as examples/mnist_lenet.py — full GRACE pipeline
+(compensate → compress → update → exchange) over the device mesh — so a
+healthy accuracy curve here is end-to-end evidence that compressed training
+converges on real data.
+
+Run (simulated 8-device mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/digits_lenet.py --compressor topk \\
+        --compress-ratio 0.01 --memory residual --tsv logs/digits_topk1pct.tsv
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import common  # noqa: E402 — sys.path bootstrap so grace_tpu imports resolve
+from grace_tpu import grace_from_params
+from grace_tpu.data import digits_dataset
+from grace_tpu.models import lenet
+from grace_tpu.parallel import batch_sharded, data_parallel_mesh
+from grace_tpu.train import (init_stateful_train_state, make_eval_step,
+                             make_stateful_train_step)
+from grace_tpu.utils import TableLogger, Timer, rank_zero_print, wire_report
+
+
+
+def run(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    common.add_grace_args(parser)
+    parser.add_argument("--epochs", type=int, default=60)
+    parser.add_argument("--batch-size", type=int, default=128,
+                        help="global batch (split across the mesh)")
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--tsv", default=None,
+                        help="write per-epoch log (epoch\\tloss\\tacc) here")
+    args = parser.parse_args(argv)
+
+    mesh = data_parallel_mesh()
+    train = digits_dataset(train=True)
+    test = digits_dataset(train=False)
+    x_train = train.normalize(train.images)
+    y_train = train.labels
+    # Eval uses the train stats (the torchvision convention), full test split.
+    x_test = train.normalize(test.images)
+    y_test = test.labels
+
+    grace = grace_from_params(common.grace_params_from_args(args))
+    optimizer = optax.chain(grace.transform(seed=args.seed),
+                            optax.sgd(args.lr, momentum=0.9))
+    params, mstate = lenet.init(jax.random.key(args.seed))
+    rank_zero_print("wire cost:", wire_report(grace.compressor, params))
+
+    def loss_fn(params, mstate, batch):
+        xb, yb = batch
+        logits, new_mstate = lenet.apply(params, mstate, xb)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        return loss.mean(), new_mstate
+
+    step = make_stateful_train_step(loss_fn, optimizer, mesh)
+    ts = init_stateful_train_state(params, mstate, optimizer, mesh)
+
+    # Test split (360) is smaller than a sharded batch budget; evaluate
+    # replicated on host-fed device 0 — exactness matters more than speed.
+    eval_fn = jax.jit(lambda p, s, x: lenet.apply(p, s, x, train=False))
+
+    def accuracy(params, mstate):
+        logits, _ = eval_fn(params, mstate, jnp.asarray(x_test))
+        return float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(y_test)))
+
+    log = TableLogger()
+    timer = Timer()
+    rows = ["epoch\ttrain_loss\ttest_acc"]
+    test_acc = 0.0
+    for epoch in range(1, args.epochs + 1):
+        losses = []
+        for xb, yb in common.batches(x_train, y_train, args.batch_size,
+                                     shuffle=True, seed=args.seed + epoch):
+            batch = jax.device_put((jnp.asarray(xb), jnp.asarray(yb)),
+                                   batch_sharded(mesh))
+            ts, loss = step(ts, batch)
+            losses.append(loss)
+        train_loss = float(jnp.mean(jnp.stack(losses)))
+        test_acc = accuracy(ts.params, ts.model_state)
+        log.append({"epoch": epoch, "train loss": train_loss,
+                    "epoch time": timer(), "test acc": test_acc})
+        rows.append(f"{epoch}\t{train_loss:.4f}\t{test_acc:.4f}")
+
+    if args.tsv:
+        os.makedirs(os.path.dirname(args.tsv) or ".", exist_ok=True)
+        with open(args.tsv, "w") as f:
+            f.write("\n".join(rows) + "\n")
+        rank_zero_print(f"log -> {args.tsv}")
+    return test_acc
+
+
+if __name__ == "__main__":
+    acc = run()
+    rank_zero_print(f"final test accuracy: {acc:.4f}")
