@@ -25,7 +25,7 @@ from grace_tpu.core import DEFAULT_AXIS
 
 __all__ = ["DEFAULT_AXIS", "data_parallel_mesh", "make_mesh",
            "initialize_distributed", "replicated", "batch_sharded",
-           "local_world_size"]
+           "local_world_size", "broadcast_tree", "metric_average"]
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
@@ -79,3 +79,42 @@ def batch_sharded(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
 
 def local_world_size(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> int:
     return mesh.shape[axis_name]
+
+
+def broadcast_tree(tree, root_process: int = 0):
+    """Broadcast a host pytree from one process to all (multi-host init sync).
+
+    The pure-JAX analog of the reference's init-time parameter broadcast
+    (examples/torch/pytorch_mnist.py:116 ``hvd.broadcast_parameters``, and
+    the BroadcastGlobalVariablesCallback of
+    examples/tensorflow/tensorflow2_keras_mnist.py:73). Initializing params
+    from the same seed on every process already makes replicas identical by
+    construction; use this when init is *not* deterministic across hosts
+    (e.g. restored from a host-local file) to make the sync explicit.
+
+    Single-process: identity. Multi-process: every leaf is replaced by
+    ``root_process``'s value on all hosts.
+    """
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(
+        tree, is_source=jax.process_index() == root_process)
+
+
+def metric_average(metrics):
+    """Average a host-side metrics pytree across processes.
+
+    The reference's ``metric_average`` idiom
+    (examples/torch/pytorch_mnist.py:163-166: allreduce a scalar, return the
+    mean). For metrics computed inside a jitted eval step prefer
+    :func:`grace_tpu.train.make_eval_step`, which pmeans on-device; this
+    helper is for host-side values (e.g. per-process validation accuracy
+    over a host-sharded eval set).
+    """
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), metrics)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(metrics)
+    return jax.tree_util.tree_map(
+        lambda g: np.mean(np.asarray(g), axis=0), gathered)

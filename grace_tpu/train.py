@@ -20,9 +20,10 @@ what the step functions expect.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -34,7 +35,8 @@ from grace_tpu.transform import (add_world_axis, partition_specs,
 
 __all__ = ["TrainState", "StatefulTrainState", "make_train_step",
            "make_stateful_train_step", "make_eval_step",
-           "init_train_state", "init_stateful_train_state"]
+           "init_train_state", "init_stateful_train_state",
+           "warmup_schedule"]
 
 
 class TrainState(NamedTuple):
@@ -157,6 +159,31 @@ def init_stateful_train_state(params: Any, model_state: Any,
         params=jax.device_put(params, replicated(mesh)),
         model_state=jax.device_put(model_state, replicated(mesh)),
         opt_state=_init_opt_state(params, optimizer, mesh, axis_name))
+
+
+def warmup_schedule(base_lr: float, world_size: int, warmup_steps: int,
+                    after: Optional[Callable[[Any], Any]] = None):
+    """Linear-scaling LR warmup: ramp ``base_lr`` → ``base_lr * world_size``.
+
+    The pure-JAX analog of the reference's LearningRateWarmupCallback
+    (examples/tensorflow/tensorflow2_keras_mnist.py:83-88, Goyal et al.
+    gradual warmup): large data-parallel batches want the linearly-scaled
+    rate ``base_lr * world_size``, reached gradually over ``warmup_steps``
+    to avoid early divergence. Returns an optax schedule; ``after(t)``
+    optionally supplies the post-warmup schedule as a function of steps
+    *since warmup end* (default: hold the scaled rate).
+    """
+    scaled = base_lr * world_size
+
+    def schedule(count):
+        frac = jnp.minimum(count / jnp.maximum(warmup_steps, 1), 1.0)
+        warm = base_lr + (scaled - base_lr) * frac
+        if after is None:
+            return warm
+        return jnp.where(count < warmup_steps, warm,
+                         after(count - warmup_steps))
+
+    return schedule
 
 
 def make_eval_step(metric_fn: Callable[[Any, Any], Any], mesh: Mesh,
