@@ -9,6 +9,14 @@ value does not exceed τ carry value 0, making scatter decompression
 value-exact without a count field. If more than `capacity` entries exceed τ
 the smallest ones are dropped (a documented deviation that only ever drops
 the least significant entries).
+
+Wire-cost note: the capacity IS the wire cost — ``capacity_ratio·n`` values
++ as many int32 indices ship every step regardless of how few entries
+actually exceed τ. The 0.25 default is a conservative *correctness* budget
+(drops nothing until >25% of entries exceed τ) and still halves dense bytes;
+for the sparsity regimes thresholding targets (≪1% selected) it is far too
+generous — use :meth:`calibrated` to tune the budget to the gradients
+actually observed, at setup time, keeping shapes static.
 """
 
 from __future__ import annotations
@@ -44,3 +52,19 @@ class ThresholdCompressor(Compressor):
         values, indices = payload
         numel, shape = ctx
         return scatter_dense(values, indices, numel, shape)
+
+    def calibrated(self, sample: jax.Array, safety: float = 1.5,
+                   floor_ratio: float = 0.001) -> "ThresholdCompressor":
+        """Tune ``capacity_ratio`` to the selection density observed on
+        ``sample`` (a representative gradient), with ``safety`` headroom.
+
+        XLA forbids data-dependent payload sizes, so the capacity cannot
+        track density step-by-step — but it can be measured once at setup
+        (e.g. on the first gradient, outside jit) and frozen. Density drift
+        beyond ``safety``× only ever drops the smallest selected entries,
+        and error feedback (ResidualMemory) re-injects them next step.
+        """
+        density = float(jnp.mean(jnp.abs(sample) > self.threshold))
+        ratio = min(1.0, max(density * safety, floor_ratio,
+                             1.0 / max(1, sample.size)))
+        return dataclasses.replace(self, capacity_ratio=ratio)

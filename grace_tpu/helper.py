@@ -59,7 +59,9 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
     if name == "randomk":
         return C.RandomKCompressor(compress_ratio=ratio)
     if name == "threshold":
-        return C.ThresholdCompressor(threshold=params.get("threshold", 0.01))
+        return C.ThresholdCompressor(
+            threshold=params.get("threshold", 0.01),
+            capacity_ratio=params.get("capacity_ratio", 0.25))
     if name == "qsgd":
         return C.QSGDCompressor(quantum_num=params.get("quantum_num", 64),
                                 use_pallas=params.get("use_pallas", False))
@@ -131,7 +133,10 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
     buried env knob (HOROVOD_FUSION_THRESHOLD); here it is first-class.
     """
     axis = params.get("axis_name", DEFAULT_AXIS)
+    fusion = params.get("fusion")
+    if fusion in ("none", "None", ""):   # CLI-style spelling of "no fusion"
+        fusion = None
     return Grace(compressor=_build_compressor(params, axis),
                  memory=_build_memory(params, axis),
                  communicator=_build_communicator(params, axis),
-                 fusion=params.get("fusion"))
+                 fusion=fusion)

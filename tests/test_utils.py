@@ -3,6 +3,7 @@
 import io
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -89,6 +90,42 @@ class TestWireMetrics:
         x = jnp.zeros((1024,), jnp.float32)
         b = payload_nbytes(C.SignSGDCompressor(), x)
         assert b < 1024 * 4
+
+    def test_shipped_defaults_beat_dense_bytes(self):
+        # VERDICT round-1 item 9: every compressor's default config must cost
+        # less on the wire than shipping the dense gradient (None excepted —
+        # it IS the dense baseline).
+        # 2-D input: PowerSGD's low-rank factorization degenerates on
+        # vectors (P+Q of a 1xN matrix costs as much as N values).
+        x = jnp.zeros((64, 64), jnp.float32)
+        dense = 64 * 64 * 4
+        for comp in [C.FP16Compressor(), C.TopKCompressor(),
+                     C.RandomKCompressor(), C.ThresholdCompressor(),
+                     C.QSGDCompressor(), C.TernGradCompressor(),
+                     C.SignSGDCompressor(), C.SignumCompressor(),
+                     C.EFSignSGDCompressor(), C.OneBitCompressor(),
+                     C.NaturalCompressor(), C.DgcCompressor(),
+                     C.AdaqCompressor(),
+                     C.U8bitCompressor(), C.SketchCompressor(),
+                     C.InceptionNCompressor()]:
+            # (PowerSGD excluded: it psums inside compress, so its cost is
+            # only measurable inside shard_map — covered in test_fusion.)
+            assert payload_nbytes(comp, x) < dense, type(comp).__name__
+
+    def test_threshold_calibrated_tracks_density(self):
+        # 2% of entries exceed tau -> capacity tuned to ~3% (1.5x safety),
+        # two orders tighter than the 25% correctness default.
+        rng = np.random.default_rng(0)
+        g = jnp.asarray(rng.standard_normal(10_000) * 0.001)
+        g = g.at[:200].set(1.0)   # 2% large entries
+        comp = C.ThresholdCompressor(threshold=0.01)
+        tuned = comp.calibrated(g)
+        assert np.isclose(tuned.capacity_ratio, 0.03, atol=0.005)
+        assert payload_nbytes(tuned, g) < payload_nbytes(comp, g) / 5
+        # round-trip stays exact: capacity still covers every selected entry
+        payload, ctx, _ = tuned.compress(g, None, jax.random.key(0))
+        out = tuned.decompress(payload, ctx)
+        np.testing.assert_allclose(np.asarray(out)[:200], 1.0)
 
     def test_wire_report_over_tree(self):
         tree = {"w": jnp.zeros((100, 10)), "b": jnp.zeros((10,))}
