@@ -101,6 +101,26 @@ def test_powersgd_inside_compress(mesh, rng):
     np.testing.assert_allclose(out, x.mean(0), atol=1e-3)
 
 
+def test_powersgd_hwio_matricization(mesh, rng):
+    """4-D conv kernels factor on the output-channel (last) dim — the
+    (shape[0], -1) rule of the torch reference would give a degenerate
+    (3, rest) matrix for HWIO layouts (wire cost > dense; see
+    compressors/powersgd.py docstring)."""
+    x = rng.normal(size=(W, 3, 3, 4, 8)).astype(np.float32)
+    comp = C.PowerSGDCompressor(rank=4, axis_name="data")
+
+    q0 = comp.init_state(jnp.asarray(x[0]))
+    # Q factors over the 8-channel output dim, not the 3-tall kernel dim.
+    assert q0.shape == (8, 4)
+
+    out = run_exchange(mesh, comm.Allreduce(), comp, jnp.asarray(x))
+    assert out.shape == x.shape[1:]
+    # rank-4 truncation of a (36, 8) matrix: inexact but must be a real
+    # low-rank approximation of the mean, not garbage.
+    err = np.linalg.norm(out - x.mean(0)) / np.linalg.norm(x.mean(0))
+    assert err < 0.9, err
+
+
 def test_powersgd_1d_bypass(mesh, rng):
     x = rng.normal(size=(W, 9)).astype(np.float32)
     comp = C.PowerSGDCompressor(rank=2, axis_name="data")
