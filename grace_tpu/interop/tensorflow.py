@@ -89,10 +89,44 @@ class TFExchanger:
         return results
 
 
+_EXCHANGERS: dict = {}   # id(grace) -> (weakref(grace), {(mesh, seed): ex})
+
+
+def _shared_exchanger(grace: Grace, mesh, seed: int) -> TFExchanger:
+    """One TFExchanger per Grace *instance* (per mesh/seed), process-wide.
+
+    The reference idiom wraps the tape anew every training step
+    (examples/tensorflow/tensorflow2_mnist.py:71); a per-wrap exchanger
+    would rebuild its GraceBridge each step — recompiling the jitted
+    exchange AND resetting error-feedback state. Sharing keeps residuals/
+    momenta alive across steps exactly like the reference's process-lifetime
+    Memory dicts.
+
+    Keyed by object identity, not equality: two independently built Grace
+    configs compare equal (frozen dataclasses), but each user-constructed
+    bundle carries its own error-feedback state — one Grace per model, as in
+    the reference where state lives in the user's communicator object. A
+    weakref finalizer evicts entries when the Grace is garbage-collected, so
+    sweeping many configs in one process doesn't pin model-sized residual
+    buffers forever.
+    """
+    key = id(grace)
+    entry = _EXCHANGERS.get(key)
+    if entry is None or entry[0]() is not grace:   # new object or id reuse
+        import weakref
+        ref = weakref.ref(grace, lambda _, k=key: _EXCHANGERS.pop(k, None))
+        entry = _EXCHANGERS[key] = (ref, {})
+    sub = entry[1]
+    ex = sub.get((mesh, seed))
+    if ex is None:
+        ex = sub[(mesh, seed)] = TFExchanger(grace, mesh=mesh, seed=seed)
+    return ex
+
+
 def DistributedGradientTape(gradtape, grace: Grace, mesh=None, seed: int = 0):
     """Wrap ``tf.GradientTape`` so ``gradient()`` returns aggregated grads."""
     _require_tf()
-    exchanger = TFExchanger(grace, mesh=mesh, seed=seed)
+    exchanger = _shared_exchanger(grace, mesh, seed)
 
     class _Wrapped(type(gradtape)):
         def __init__(self):
