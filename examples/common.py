@@ -22,6 +22,20 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
+# Honor JAX_PLATFORMS=cpu even where a sitecustomize pre-imports jax and pins
+# an accelerator platform (ignoring the env var set at launch). Re-asserting
+# via jax.config is legal until the first backend initializes, so it must
+# happen here — before any grace_tpu/jax device touch.
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    import re as _re
+
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+    _m = _re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                    os.environ.get("XLA_FLAGS", ""))
+    if _m:
+        _jax.config.update("jax_num_cpu_devices", int(_m.group(1)))
+
 import numpy as np
 
 GRACE_FLAG_DOC = """GRACE compression flags (reference params-dict schema,
