@@ -17,6 +17,9 @@ subprocess under a hard timeout; the orchestrator first probes backend init
 separately, retries once, and on TPU failure falls back to an 8-device
 simulated-CPU mesh so a real number is captured either way. Stage
 diagnostics go to stderr; stdout carries only the final JSON line.
+
+The measurement core (`bench_configs`) is shared with bench_all.py, which
+sweeps the whole BASELINE.json config list instead of the headline pair.
 """
 
 from __future__ import annotations
@@ -31,12 +34,26 @@ PROBE_TIMEOUTS_S = (180, 420)  # healthy tunnel inits in seconds; second
                                # probe gets a long leash for slow cold init
 WORKER_TIMEOUT_S = 1200        # full bench incl. first compile (~20-40s/fn)
 
+HEADLINE = [
+    # Both sides get the fusion buffer — Horovod fuses the uncompressed
+    # baseline too, so a like-for-like ratio must as well.
+    {"name": "none", "params": {"compressor": "none", "memory": "none",
+                                "communicator": "allreduce",
+                                "fusion": "flat"}},
+    {"name": "topk1pct", "params": {"compressor": "topk",
+                                    "compress_ratio": 0.01,
+                                    "memory": "residual",
+                                    "communicator": "allgather",
+                                    "fusion": "flat"}},
+]
+
 
 # --------------------------------------------------------------------------
-# Worker: the actual measurement (runs in a subprocess)
+# Measurement core (runs inside a worker subprocess; also used by bench_all)
 # --------------------------------------------------------------------------
 
-def _worker(platform: str) -> None:
+def setup_platform(platform: str):
+    """Pin jax to the requested platform BEFORE any backend init."""
     import jax
 
     if platform == "cpu":
@@ -44,17 +61,25 @@ def _worker(platform: str) -> None:
         # jax onto the TPU tunnel, so env vars alone are not enough.
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", 8)
+    devices = jax.devices()
+    if platform == "tpu" and devices[0].platform != "tpu":
+        raise RuntimeError(f"wanted tpu, got {devices[0].platform}")
+    return devices
 
+
+def bench_configs(platform: str, configs, emit) -> None:
+    """Measure each config's ResNet-50 training throughput; call
+    ``emit(result_dict)`` per config (first config = the dense baseline)."""
+    devices = setup_platform(platform)
+
+    import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
     from grace_tpu.parallel import batch_sharded, data_parallel_mesh
 
-    devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
-    if platform == "tpu" and not on_tpu:
-        raise RuntimeError(f"wanted tpu, got {devices[0].platform}")
     mesh = data_parallel_mesh(devices)
 
     def build_step(grace_params, num_classes):
@@ -77,7 +102,7 @@ def _worker(platform: str) -> None:
         params, mstate = resnet.init(jax.random.key(0), depth=50,
                                      num_classes=num_classes)
         ts = init_stateful_train_state(params, mstate, optimizer, mesh)
-        return step, ts
+        return step, ts, grace, params
 
     def throughput(step, ts, batch, n_batches, warmup=2):
         # Fetch-bounded timing: on the axon tunnel block_until_ready does not
@@ -114,35 +139,68 @@ def _worker(platform: str) -> None:
     y = jnp.asarray(rng.integers(0, num_classes, (n,)), jnp.int32)
     batch = jax.device_put((x, y), batch_sharded(mesh))
 
-    def run(grace_params):
-        # best-of-N to damp chip/host jitter (~8% run-to-run on the tunnel)
-        step, ts = build_step(grace_params, num_classes)
-        best = 0.0
-        for _ in range(repeats):
-            tput, ts = throughput(step, ts, batch, n_batches, warmup=4)
-            best = max(best, tput)
-        return best
+    def wire_bytes(grace, params):
+        """Bytes-on-wire per step per rank. PowerSGD gets an analytic count
+        (its compress psums inside shard_map, out of wire_report's reach);
+        any other compressor that fails wire_report is a real bug — re-raise
+        rather than emit plausible-looking wrong numbers."""
+        from grace_tpu.compressors import PowerSGDCompressor
+        from grace_tpu.utils import wire_report
+        if isinstance(grace.compressor, PowerSGDCompressor):
+            # Metadata-only arithmetic: the training step donates its state,
+            # so the underlying buffers may already be deleted here.
+            leaves = jax.tree_util.tree_leaves(params)
+            dense = sum(l.size * 4 for l in leaves)
+            rank = grace.compressor.rank
+            wire = 0
+            for l in leaves:
+                if l.ndim < 2:
+                    wire += l.size * 4
+                else:
+                    # (-1, shape[-1]) matricization, see compressors/powersgd
+                    cols = l.shape[-1]
+                    rows = l.size // cols
+                    wire += (rows + cols) * min(rows, cols, rank) * 4
+            return dense, wire
+        rep = wire_report(grace.compressor, params)
+        return rep.dense_bytes, rep.wire_bytes
 
     print(f"[bench] mesh: {len(devices)}x {devices[0].platform}",
           file=sys.stderr, flush=True)
-    # Both sides get the fusion buffer — Horovod fuses the uncompressed
-    # baseline too, so a like-for-like ratio must as well.
-    baseline = run({"compressor": "none", "memory": "none",
-                    "communicator": "allreduce", "fusion": "flat"})
-    print(f"[bench] baseline uncompressed: {baseline:.2f} imgs/sec",
-          file=sys.stderr, flush=True)
-    compressed = run({"compressor": "topk", "compress_ratio": 0.01,
-                      "memory": "residual", "communicator": "allgather",
-                      "fusion": "flat"})
-    print(f"[bench] topk-1%: {compressed:.2f} imgs/sec",
-          file=sys.stderr, flush=True)
+    baseline = None
+    for cfg in configs:
+        step, ts, grace, params = build_step(cfg["params"], num_classes)
+        best = 0.0
+        # best-of-N to damp chip/host jitter (~8% run-to-run on the tunnel)
+        for _ in range(repeats):
+            tput, ts = throughput(step, ts, batch, n_batches, warmup=4)
+            best = max(best, tput)
+        dense_b, wire_b = wire_bytes(grace, params)
+        if baseline is None:
+            baseline = best
+        print(f"[bench] {cfg['name']}: {best:.2f} imgs/sec",
+              file=sys.stderr, flush=True)
+        emit({
+            "config": cfg["name"],
+            "imgs_per_sec": round(best, 2),
+            "vs_baseline": round(best / baseline, 4),
+            "wire_bytes_per_step": wire_b,
+            "wire_ratio": round(wire_b / max(1, dense_b), 6),
+            "platform": devices[0].platform,
+            "n_devices": len(devices),
+        })
 
+
+def _worker(platform: str) -> None:
+    results = []
+    bench_configs(platform, HEADLINE, results.append)
+    compressed = results[1]
     print(json.dumps({
         "metric": "resnet50_topk1pct_imgs_per_sec",
-        "value": round(compressed, 2),
+        "value": compressed["imgs_per_sec"],
         "unit": "imgs/sec",
-        "vs_baseline": round(compressed / baseline, 4),
-        "platform": devices[0].platform,
+        "vs_baseline": compressed["vs_baseline"],
+        "platform": compressed["platform"],
     }), flush=True)
 
 
@@ -164,17 +222,23 @@ def _run_sub(args, timeout, extra_env=None):
         return 124, out, f"timeout after {timeout}s"
 
 
-def _last_json_line(stdout: str):
-    for line in reversed(stdout.strip().splitlines()):
+def _json_lines(stdout: str, key: str):
+    found = []
+    for line in stdout.strip().splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
                 obj = json.loads(line)
-                if "metric" in obj:
-                    return obj
+                if key in obj:
+                    found.append(obj)
             except json.JSONDecodeError:
                 continue
-    return None
+    return found
+
+
+def _last_json_line(stdout: str):
+    lines = _json_lines(stdout, "metric")
+    return lines[-1] if lines else None
 
 
 def _probe_tpu(timeout: float) -> bool:
@@ -188,21 +252,34 @@ def _probe_tpu(timeout: float) -> bool:
     return ok
 
 
-def main() -> None:
+def orchestrate(script_path: str, parse, emit_failure,
+                worker_timeout: float = WORKER_TIMEOUT_S,
+                salvage=None) -> bool:
+    """probe TPU -> run worker (retry once) -> CPU fallback.
+
+    ``parse(stdout, stages) -> result|None`` extracts and emits the worker's
+    output (``stages`` records earlier probe/attempt failures so a
+    degraded CPU-fallback run stays diagnosable); ``emit_failure(stages)``
+    prints the failure JSON. ``salvage(stdout)``, if given, sees every
+    *failed* attempt's captured stdout so partial per-line results survive a
+    mid-sweep timeout. Returns success.
+    """
     stages = []
-    here = os.path.abspath(__file__)
+
+    def attempt_failed(out):
+        if salvage is not None:
+            salvage(out)
 
     for attempt, probe_timeout in enumerate(PROBE_TIMEOUTS_S, start=1):
         if not _probe_tpu(probe_timeout):
             stages.append({"stage": "backend_init", "attempt": attempt,
                            "error": "tpu probe failed/timed out"})
             continue
-        rc, out, err = _run_sub([here, "--_worker", "tpu"], WORKER_TIMEOUT_S)
-        result = _last_json_line(out)
-        if rc == 0 and result:
-            result["stages"] = stages
-            print(json.dumps(result), flush=True)
-            return
+        rc, out, err = _run_sub([script_path, "--_worker", "tpu"],
+                                worker_timeout)
+        if rc == 0 and parse(out, stages):
+            return True
+        attempt_failed(out)
         stages.append({"stage": "tpu_bench", "attempt": attempt, "rc": rc,
                        "error": err[-500:]})
         print(f"[bench] tpu attempt {attempt} failed rc={rc}: {err[-500:]}",
@@ -210,19 +287,34 @@ def main() -> None:
 
     print("[bench] falling back to 8-device simulated-CPU mesh",
           file=sys.stderr, flush=True)
-    rc, out, err = _run_sub([here, "--_worker", "cpu"], WORKER_TIMEOUT_S)
-    result = _last_json_line(out)
-    if rc == 0 and result:
-        result["stages"] = stages
-        print(json.dumps(result), flush=True)
-        return
+    rc, out, err = _run_sub([script_path, "--_worker", "cpu"], worker_timeout)
+    if rc == 0 and parse(out, stages):
+        return True
+    attempt_failed(out)
     stages.append({"stage": "cpu_bench", "rc": rc, "error": err[-500:]})
-    print(json.dumps({
-        "metric": "resnet50_topk1pct_imgs_per_sec",
-        "value": None, "unit": "imgs/sec", "vs_baseline": None,
-        "stages": stages,
-    }), flush=True)
-    sys.exit(1)
+    emit_failure(stages)
+    return False
+
+
+def main() -> None:
+    here = os.path.abspath(__file__)
+
+    def parse(out, stages):
+        result = _last_json_line(out)
+        if result:
+            result["stages"] = stages
+            print(json.dumps(result), flush=True)
+        return result
+
+    def emit_failure(stages):
+        print(json.dumps({
+            "metric": "resnet50_topk1pct_imgs_per_sec",
+            "value": None, "unit": "imgs/sec", "vs_baseline": None,
+            "stages": stages,
+        }), flush=True)
+
+    if not orchestrate(here, parse, emit_failure):
+        sys.exit(1)
 
 
 if __name__ == "__main__":

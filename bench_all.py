@@ -1,0 +1,130 @@
+"""Per-algorithm benchmark table: every BASELINE.json config, one JSON line each.
+
+Sweeps the algorithm catalog over the same ResNet-50 synthetic protocol as
+bench.py (shared measurement core) and reports, per config: training
+imgs/sec, ratio vs the uncompressed-allreduce baseline, and bytes-on-wire
+per step per rank (grace_tpu.utils.wire_report — a first-class metric the
+reference never measured). Covers BASELINE.json configs 2-5: Top-K 1%,
+QSGD/TernGrad, PowerSGD rank-4, 1-bit/signSGD; plus a fusion ablation for
+the headline pair (flat vs unfused — Horovod's 64MiB-fusion-buffer analog,
+SURVEY.md §2.4).
+
+Usage:
+    python bench_all.py             # probe TPU, fall back to CPU mesh
+    python bench_all.py --_worker cpu   # force the simulated-CPU mesh
+
+Output: one JSON line per config on stdout, e.g.
+  {"config": "qsgd", "imgs_per_sec": ..., "vs_baseline": ...,
+   "wire_bytes_per_step": ..., "wire_ratio": ..., "platform": "tpu"}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import bench
+
+CONFIGS = [
+    # The headline pair (dense baseline first) comes verbatim from bench.py
+    # so the two benchmarks can never drift apart.
+    *bench.HEADLINE,
+    # TPU-first Top-K selection variants (exact top-k lowers to a full sort —
+    # the most expensive op in the pipeline; see compressors/topk.py):
+    {"name": "topk1pct_approx", "params": {"compressor": "topk",
+                                           "compress_ratio": 0.01,
+                                           "topk_algorithm": "approx",
+                                           "memory": "residual",
+                                           "communicator": "allgather",
+                                           "fusion": "flat"}},
+    {"name": "topk1pct_chunk", "params": {"compressor": "topk",
+                                          "compress_ratio": 0.01,
+                                          "topk_algorithm": "chunk",
+                                          "memory": "residual",
+                                          "communicator": "allgather",
+                                          "fusion": "flat"}},
+    {"name": "qsgd",       "params": {"compressor": "qsgd",
+                                      "quantum_num": 64,
+                                      "memory": "none",
+                                      "communicator": "allgather",
+                                      "fusion": "flat"}},
+    {"name": "terngrad",   "params": {"compressor": "terngrad",
+                                      "memory": "none",
+                                      "communicator": "allgather",
+                                      "fusion": "flat"}},
+    {"name": "powersgd_r4", "params": {"compressor": "powersgd",
+                                       "compress_rank": 4,
+                                       "memory": "powersgd",
+                                       "communicator": "allreduce",
+                                       "fusion": "none"}},
+    {"name": "signsgd",    "params": {"compressor": "signsgd",
+                                      "memory": "none",
+                                      "communicator": "allgather",
+                                      "fusion": "flat"}},
+    {"name": "onebit",     "params": {"compressor": "onebit",
+                                      "memory": "residual",
+                                      "communicator": "allgather",
+                                      "fusion": "flat"}},
+    # Fusion ablation (headline pair without the fusion buffer):
+    {"name": "none_unfused", "params": {"compressor": "none",
+                                        "memory": "none",
+                                        "communicator": "allreduce",
+                                        "fusion": "none"}},
+    {"name": "topk1pct_unfused", "params": {"compressor": "topk",
+                                            "compress_ratio": 0.01,
+                                            "memory": "residual",
+                                            "communicator": "allgather",
+                                            "fusion": "none"}},
+]
+
+# Per-config budget: first compile dominates (~20-40s TPU, minutes on the
+# CPU fallback mesh), so size the worker timeout by sweep length.
+WORKER_TIMEOUT_S = 600 * len(CONFIGS)
+
+
+def _worker(platform: str) -> None:
+    bench.bench_configs(platform, CONFIGS,
+                        lambda r: print(json.dumps(r), flush=True))
+
+
+def main() -> None:
+    here = os.path.abspath(__file__)
+    best_partial: list = []
+
+    def salvage(out):
+        # Keep the longest prefix of per-config rows any failed attempt
+        # produced — a mid-sweep timeout should not discard measured configs.
+        rows = bench._json_lines(out, "config")
+        if len(rows) > len(best_partial):
+            best_partial[:] = rows
+
+    def parse(out, stages):
+        rows = bench._json_lines(out, "config")
+        if len(rows) != len(CONFIGS):
+            return None
+        for r in rows:
+            if stages:
+                r["stages"] = stages
+            print(json.dumps(r), flush=True)
+        return rows
+
+    def emit_failure(stages):
+        for r in best_partial:
+            r["partial"] = True
+            print(json.dumps(r), flush=True)
+        print(json.dumps({"config": None, "error": "all attempts failed",
+                          "partial_rows": len(best_partial),
+                          "stages": stages}), flush=True)
+
+    if not bench.orchestrate(here, parse, emit_failure,
+                             worker_timeout=WORKER_TIMEOUT_S,
+                             salvage=salvage):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--_worker":
+        _worker(sys.argv[2])
+    else:
+        main()
