@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+import common  # noqa: E402 — sys.path bootstrap so grace_tpu imports resolve
 from grace_tpu import grace_from_params
 from grace_tpu.models import lenet
 from grace_tpu.parallel import (batch_sharded, data_parallel_mesh,
@@ -30,7 +31,6 @@ from grace_tpu.train import (init_stateful_train_state, make_eval_step,
                              make_stateful_train_step)
 from grace_tpu.utils import TableLogger, Timer, rank_zero_print, wire_report
 
-import common
 
 
 def main():

@@ -19,6 +19,7 @@ import numpy as np
 import torch
 import torch.nn.functional as F
 
+import common  # noqa: E402 — sys.path bootstrap so grace_tpu imports resolve
 from grace_tpu import grace_from_params
 from grace_tpu.interop.torch import (DistributedOptimizer,
                                      broadcast_optimizer_state,
@@ -26,7 +27,6 @@ from grace_tpu.interop.torch import (DistributedOptimizer,
 from grace_tpu.parallel import data_parallel_mesh, initialize_distributed
 from grace_tpu.utils import TableLogger, Timer, rank_zero_print
 
-import common
 
 
 class Net(torch.nn.Module):
