@@ -66,7 +66,8 @@ CONFIGS = [
                                       "memory": "residual",
                                       "communicator": "allgather",
                                       "fusion": "flat"}},
-    # Fusion ablation (headline pair without the fusion buffer):
+    # Fusion ablation (headline pair unfused, and Horovod's default 64 MiB
+    # bucketing — SURVEY.md §2.4):
     {"name": "none_unfused", "params": {"compressor": "none",
                                         "memory": "none",
                                         "communicator": "allreduce",
@@ -76,6 +77,11 @@ CONFIGS = [
                                             "memory": "residual",
                                             "communicator": "allgather",
                                             "fusion": "none"}},
+    {"name": "topk1pct_64mib", "params": {"compressor": "topk",
+                                          "compress_ratio": 0.01,
+                                          "memory": "residual",
+                                          "communicator": "allgather",
+                                          "fusion": 64 * 2**20}},
 ]
 
 # Per-config budget: first compile dominates (~20-40s TPU, minutes on the

@@ -92,6 +92,41 @@ class TestDistributedGradientTape:
         np.testing.assert_allclose(gb.numpy(), 2 * 2.0 * b.numpy(),
                                    rtol=1e-6)
 
+    def test_per_step_wrapping_shares_exchanger_and_state(self, mesh):
+        """The reference idiom wraps the tape anew every step; the shared
+        exchanger must persist (no per-step recompile) and carry residual
+        error-feedback state across wraps — while a *different* Grace object
+        with an equal config must get its own exchanger (residuals are
+        per-model state)."""
+        from grace_tpu.interop.tensorflow import _shared_exchanger
+
+        cfg = {"compressor": "topk", "compress_ratio": 0.34,
+               "memory": "residual", "communicator": "allgather"}
+        grc = grace_from_params(cfg)
+        v = tf.Variable([1.0, 2.0, 3.0])
+
+        def one_step():
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_sum(v * v)
+            tape = DistributedGradientTape(tape, grc, mesh=mesh)
+            return tape.gradient(loss, v)
+
+        one_step()
+        ex1 = _shared_exchanger(grc, mesh, 0)
+        state1 = ex1._bridge.state
+        res1 = np.asarray(state1.mem[0])        # GraceState.mem residuals
+        assert np.abs(res1).sum() > 0           # topk 34% left a residual
+        one_step()
+        ex2 = _shared_exchanger(grc, mesh, 0)
+        assert ex1 is ex2                       # same bridge across wraps
+        res2 = np.asarray(ex2._bridge.state.mem[0])
+        assert not np.array_equal(res1, res2)   # state advanced, not reset
+
+        twin = grace_from_params(cfg)
+        assert twin == grc                      # equal config...
+        ex3 = _shared_exchanger(twin, mesh, 0)
+        assert ex3 is not ex1                   # ...but its own state
+
     def test_training_step_under_tf_function(self, mesh):
         model = keras.Sequential([keras.layers.Dense(4, activation="relu"),
                                   keras.layers.Dense(2)])
