@@ -30,14 +30,15 @@ CONFIGS = [
     # The headline pair (dense baseline first) comes verbatim from bench.py
     # so the two benchmarks can never drift apart.
     *bench.HEADLINE,
-    # TPU-first Top-K selection variants (exact top-k lowers to a full sort —
-    # the most expensive op in the pipeline; see compressors/topk.py):
-    {"name": "topk1pct_approx", "params": {"compressor": "topk",
-                                           "compress_ratio": 0.01,
-                                           "topk_algorithm": "approx",
-                                           "memory": "residual",
-                                           "communicator": "allgather",
-                                           "fusion": "flat"}},
+    # Top-K selection variants (the headline uses 'approx'; exact top-k
+    # lowers to a full sort — the most expensive op in the pipeline; see
+    # compressors/topk.py):
+    {"name": "topk1pct_exact", "params": {"compressor": "topk",
+                                          "compress_ratio": 0.01,
+                                          "topk_algorithm": "exact",
+                                          "memory": "residual",
+                                          "communicator": "allgather",
+                                          "fusion": "flat"}},
     {"name": "topk1pct_chunk", "params": {"compressor": "topk",
                                           "compress_ratio": 0.01,
                                           "topk_algorithm": "chunk",
@@ -74,11 +75,13 @@ CONFIGS = [
                                         "fusion": "none"}},
     {"name": "topk1pct_unfused", "params": {"compressor": "topk",
                                             "compress_ratio": 0.01,
+                                            "topk_algorithm": "approx",
                                             "memory": "residual",
                                             "communicator": "allgather",
                                             "fusion": "none"}},
     {"name": "topk1pct_64mib", "params": {"compressor": "topk",
                                           "compress_ratio": 0.01,
+                                          "topk_algorithm": "approx",
                                           "memory": "residual",
                                           "communicator": "allgather",
                                           "fusion": 64 * 2**20}},
