@@ -44,10 +44,13 @@ class TopKCompressor(Compressor):
     compress_ratio: float = 0.3
     algorithm: str = "exact"      # 'exact' | 'approx' | 'chunk'
     recall_target: float = 0.95   # for 'approx'
+    wire_dtype: str = "float32"   # 'float32' | 'bfloat16' wire values
 
     def __post_init__(self):
         if self.algorithm not in ("exact", "approx", "chunk"):
             raise ValueError(f"unknown topk algorithm {self.algorithm!r}")
+        if self.wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
 
     def _select(self, flat: jax.Array, k: int) -> jax.Array:
         if self.algorithm == "approx" and flat.size > 4 * k:
@@ -78,9 +81,14 @@ class TopKCompressor(Compressor):
         k = static_k(numel, self.compress_ratio)
         indices = self._select(flat, k).astype(jnp.int32)
         values = flat[indices]
-        return (values, indices), (numel, shape), state
+        if self.wire_dtype == "bfloat16":
+            # 25% fewer wire bytes (6 vs 8 per kept element, with int32
+            # indices); the rounding error lands in the residual memory and
+            # is re-injected next step — same argument as 'approx' recall.
+            values = values.astype(jnp.bfloat16)
+        return (values, indices), (numel, shape, x.dtype), state
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
         values, indices = payload
-        numel, shape = ctx
-        return scatter_dense(values, indices, numel, shape)
+        numel, shape, dtype = ctx
+        return scatter_dense(values.astype(dtype), indices, numel, shape)

@@ -105,10 +105,11 @@ class DgcMemory(Memory):
 
     def update(self, compensated: jax.Array, payload: Payload, ctx: Ctx,
                compressor: Compressor, state: State) -> State:
-        values, indices = payload
-        numel, shape = ctx
-        sent = jnp.zeros((numel,), jnp.bool_).at[indices].set(values != 0)
-        keep = (~sent).reshape(shape).astype(compensated.dtype)
+        # Zero accumulators at transmitted lanes. Layout-agnostic: unsent
+        # (and zero-valued) lanes decompress to exactly 0, so the mask needs
+        # no knowledge of the compressor's ctx tuple.
+        keep = (compressor.decompress(payload, ctx) == 0).astype(
+            compensated.dtype)
         return {"residual": state["residual"] * keep,
                 "gradient": state["gradient"] * keep}
 

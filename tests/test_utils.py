@@ -112,6 +112,21 @@ class TestWireMetrics:
             # only measurable inside shard_map — covered in test_fusion.)
             assert payload_nbytes(comp, x) < dense, type(comp).__name__
 
+    def test_topk_bf16_wire_saves_quarter(self):
+        x = jnp.zeros((1000,), jnp.float32)
+        f32 = payload_nbytes(C.TopKCompressor(compress_ratio=0.1), x)
+        bf16 = payload_nbytes(C.TopKCompressor(compress_ratio=0.1,
+                                               wire_dtype="bfloat16"), x)
+        assert f32 == 100 * 8 and bf16 == 100 * 6
+        # round-trip decodes back to the original dtype, values ~exact for
+        # bf16-representable inputs
+        comp = C.TopKCompressor(compress_ratio=0.5, wire_dtype="bfloat16")
+        g = jnp.asarray([1.5, -2.0, 0.25, 0.0])
+        payload, ctx, _ = comp.compress(g, None, jax.random.key(0))
+        out = comp.decompress(payload, ctx)
+        assert out.dtype == g.dtype
+        np.testing.assert_allclose(np.asarray(out), [1.5, -2.0, 0, 0])
+
     def test_threshold_calibrated_tracks_density(self):
         # 2% of entries exceed tau -> capacity tuned to ~3% (1.5x safety),
         # two orders tighter than the 25% correctness default.
