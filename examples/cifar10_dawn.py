@@ -33,12 +33,18 @@ from grace_tpu.utils import (TableLogger, Timer, TSVLogger, rank_zero_print)
 
 def piecewise_linear_lr(step, steps_per_epoch, peak_epoch=5, total_epochs=24,
                         peak_lr=0.4):
-    """cifar10-fast schedule: 0→peak at epoch 5, then linear to 0 at 24."""
+    """cifar10-fast schedule: 0→peak at epoch 5, then linear to 0 at 24.
+
+    Short runs (total_epochs <= peak_epoch) pull the peak forward to the
+    midpoint so the schedule stays a valid ramp instead of dividing by zero.
+    """
+    if total_epochs <= peak_epoch:
+        peak_epoch = max(1, total_epochs // 2)
     e = step / steps_per_epoch
     return jnp.where(
         e < peak_epoch, peak_lr * e / peak_epoch,
         peak_lr * jnp.maximum(0.0, (total_epochs - e)
-                              / (total_epochs - peak_epoch)))
+                              / max(total_epochs - peak_epoch, 1e-9)))
 
 
 def augment(x, rng):

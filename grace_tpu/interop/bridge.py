@@ -153,9 +153,25 @@ class GraceBridge:
     @property
     def state(self):
         """Compression state (GraceState pytree, world-axis layout) — expose
-        for checkpointing; the reference never persisted this (SURVEY.md §5)."""
+        for checkpointing; the reference never persisted this (SURVEY.md §5).
+
+        Serialize (or ``jax.device_get``) before the next :meth:`exchange`:
+        the jitted step donates the previous state buffers, so a live
+        reference held across an exchange is deleted."""
         return self._state
 
     @state.setter
     def state(self, value):
+        # Fail at assignment, not at the first exchange deep inside XLA:
+        # a restored checkpoint must match this bridge's state template
+        # (same n, same compressor config) structurally and shape-wise.
+        expect = jax.tree_util.tree_map(
+            lambda x: (jnp.shape(x), jnp.result_type(x)), self._state)
+        got = jax.tree_util.tree_map(
+            lambda x: (jnp.shape(x), jnp.result_type(x)), value)
+        if expect != got:
+            raise ValueError(
+                "restored grace state does not match this bridge's layout "
+                f"(n={self.n}, world={self.world}); expected "
+                f"{expect}, got {got}")
         self._state = value

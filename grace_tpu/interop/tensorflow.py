@@ -25,7 +25,8 @@ import numpy as np
 
 from grace_tpu.helper import Grace
 
-__all__ = ["DistributedGradientTape", "TFExchanger", "broadcast_variables"]
+__all__ = ["DistributedGradientTape", "TFExchanger", "broadcast_variables",
+           "exchanger_for"]
 
 
 def _require_tf():
@@ -54,13 +55,33 @@ class TFExchanger:
         self._mesh = mesh
         self._seed = seed
         self._bridge = None
+        self._pending_state = None   # restored state queued until build
 
     def _host_exchange(self, flat: np.ndarray) -> np.ndarray:
         from grace_tpu.interop.bridge import GraceBridge
         if self._bridge is None or self._bridge.n != flat.size:
             self._bridge = GraceBridge(self._grace, n=flat.size,
                                        mesh=self._mesh, seed=self._seed)
+            if self._pending_state is not None:
+                self._bridge.state = self._pending_state
+                self._pending_state = None
         return np.asarray(self._bridge.exchange(flat), np.float32)
+
+    @property
+    def grace_state(self):
+        """On-device compression state (None before the first exchange) —
+        include it in checkpoints; assign to restore. Restoring before the
+        first exchange is queued and applied when the bridge is built."""
+        if self._bridge is None:
+            return self._pending_state
+        return self._bridge.state
+
+    @grace_state.setter
+    def grace_state(self, value):
+        if self._bridge is None:
+            self._pending_state = value
+        else:
+            self._bridge.state = value
 
     def exchange(self, grads):
         """list of tf.Tensor/IndexedSlices/None -> same-structure aggregated."""
@@ -109,7 +130,15 @@ def _shared_exchanger(grace: Grace, mesh, seed: int) -> TFExchanger:
     weakref finalizer evicts entries when the Grace is garbage-collected, so
     sweeping many configs in one process doesn't pin model-sized residual
     buffers forever.
+
+    ``mesh=None`` is normalized to the default data-parallel mesh, so
+    ``exchanger_for(grc)`` finds the exchanger of a tape built with an
+    explicit-but-equal mesh (Mesh equality is by devices+axes) instead of
+    silently creating a fresh one.
     """
+    if mesh is None:
+        from grace_tpu.parallel import data_parallel_mesh
+        mesh = data_parallel_mesh()
     key = id(grace)
     entry = _EXCHANGERS.get(key)
     if entry is None or entry[0]() is not grace:   # new object or id reuse
@@ -121,6 +150,13 @@ def _shared_exchanger(grace: Grace, mesh, seed: int) -> TFExchanger:
     if ex is None:
         ex = sub[(mesh, seed)] = TFExchanger(grace, mesh=mesh, seed=seed)
     return ex
+
+
+def exchanger_for(grace: Grace, mesh=None, seed: int = 0) -> TFExchanger:
+    """The process-wide exchanger a DistributedGradientTape with these
+    arguments uses — access its ``grace_state`` for checkpoint/resume of the
+    compression state (see TRAINING.md)."""
+    return _shared_exchanger(grace, mesh, seed)
 
 
 def DistributedGradientTape(gradtape, grace: Grace, mesh=None, seed: int = 0):

@@ -185,6 +185,10 @@ class _DistributedOptimizer:
         """On-device compression state — include it in checkpoints."""
         return self._bridge.state
 
+    @grace_state.setter
+    def grace_state(self, value):
+        self._bridge.state = value
+
 
 def DistributedOptimizer(optimizer, grace: Grace, named_parameters=None,
                          backward_passes_per_step: int = 1,

@@ -9,6 +9,7 @@ throughout: gradients leaving the TF side are the globally aggregated,
 compressed-exchanged result of the jitted JAX pipeline.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -69,6 +70,34 @@ class TestTFExchanger:
         out = ex.exchange([tf.constant(x)])[0].numpy()
         expect = np.where(np.abs(x) >= np.sort(np.abs(x))[-2], x, 0.0)
         np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+class TestExchangerState:
+    def test_state_restore_resumes_error_feedback(self, mesh):
+        """exchanger_for + grace_state assignment (queued pre-build) must
+        reproduce an uninterrupted run — the TRAINING.md resume recipe."""
+        from grace_tpu.interop.tensorflow import exchanger_for
+
+        cfg = {"compressor": "topk", "compress_ratio": 0.25,
+               "memory": "residual", "communicator": "allgather"}
+        g = np.linspace(-1.0, 1.0, 16).astype(np.float32)
+
+        a = grace_from_params(cfg)
+        ex_a = exchanger_for(a, mesh, 0)
+        assert ex_a.grace_state is None          # no exchange yet
+        ex_a.exchange([tf.constant(g)])
+        assert ex_a.grace_state is not None
+        # Host-copy before continuing (what save_checkpoint does): the next
+        # exchange donates the previous state buffers.
+        saved = jax.device_get(ex_a.grace_state)
+        cont = ex_a.exchange([tf.constant(g)])[0].numpy()
+
+        b = grace_from_params(cfg)               # fresh process-equivalent
+        ex_b = exchanger_for(b, mesh, 0)
+        assert ex_b is not ex_a
+        ex_b.grace_state = saved                 # queued: bridge not built
+        resumed = ex_b.exchange([tf.constant(g)])[0].numpy()
+        np.testing.assert_array_equal(cont, resumed)
 
 
 class TestDistributedGradientTape:
