@@ -26,7 +26,8 @@ from jax import lax
 
 from grace_tpu.core import Communicator, Compressor, Ctx, Payload
 
-__all__ = ["Allreduce", "Allgather", "Broadcast", "Identity"]
+__all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
+           "SignAllreduce"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,6 +92,50 @@ class Broadcast(Allgather):
     On TPU we keep the all-gather realisation; semantics (per-rank decompress
     → aggregate → optional average) are identical.
     """
+
+
+@dataclasses.dataclass(frozen=True)
+class SignAllreduce(Communicator):
+    """Majority vote via psum instead of allgather (SURVEY.md §7 hard part 4).
+
+    Decompress this rank's payload to ±1, ``psum`` over the axis, re-sign —
+    mathematically identical to Allgather + the sign compressors' majority-
+    vote ``aggregate`` (sum of ±1 then sign), but the collective is a fixed-
+    cost all-reduce instead of a world-size-proportional gather. Wire math
+    per rank: allgather of packed signs receives (W-1)·n/8 bytes; an XLA
+    ring all-reduce of ±1 in bf16 moves ~2·(2n) bytes regardless of W — so
+    allgather wins on small meshes (W ≲ 32) and SignAllreduce wins on pod
+    slices beyond that. Same decision the reference could not express: its
+    allgather was the only variable-size-safe collective (IMPLEMENTING.md:
+    43-45); here both sides are static-shaped, so the choice is free.
+
+    Only valid for compressors whose decompressed tensors are exactly the
+    vote inputs and whose aggregate is the majority vote (signsgd, signum).
+    ``vote_dtype='bfloat16'`` is integer-exact for vote sums up to |W|=256;
+    pick ``'float32'`` on larger meshes.
+    """
+
+    vote_dtype: str = "bfloat16"
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        if not getattr(compressor, "vote_aggregate", False):
+            raise TypeError(
+                "SignAllreduce implements majority-vote aggregation; "
+                f"{type(compressor).__name__} does not declare "
+                "vote_aggregate=True (its aggregate carries scaling the "
+                "re-sign would drop) — use Allreduce/Allgather instead.")
+        if self.vote_dtype == "bfloat16":
+            w = jax.lax.axis_size(self.axis_name)   # static at trace time
+            if w > 256:
+                raise ValueError(
+                    f"vote_dtype='bfloat16' is integer-exact only up to "
+                    f"world size 256; this axis has {w} — use "
+                    "SignAllreduce(vote_dtype='float32').")
+        dec = compressor.decompress(payload, ctx)
+        summed = lax.psum(dec.astype(self.vote_dtype), self.axis_name)
+        out = (summed >= 0).astype(self.vote_dtype) * 2 - 1
+        return out.astype(dec.dtype)
 
 
 @dataclasses.dataclass(frozen=True)

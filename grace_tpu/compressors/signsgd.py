@@ -27,6 +27,7 @@ def _signs_to_float(bits: jax.Array, dtype) -> jax.Array:
 @dataclasses.dataclass(frozen=True)
 class SignSGDCompressor(Compressor):
     average = False
+    vote_aggregate = True   # aggregate IS the majority vote (SignAllreduce-safe)
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

@@ -60,10 +60,16 @@ class Compressor:
       XLA every payload is statically shaped, so the all-gather communicator
       never needs the reference's size-exchange dance
       (grace_dl/dist/communicator/allgather.py:16-38).
+    * ``vote_aggregate`` — True iff ``aggregate`` is exactly the majority
+      vote over ±1 decompressed tensors (signsgd/signum). Gates the
+      psum-based :class:`~grace_tpu.comm.SignAllreduce` communicator, which
+      re-signs the sum and would silently drop any other aggregate's
+      scaling (e.g. EF-SignSGD's 1/lr).
     """
 
     average = True
     tensors_size_are_same = True
+    vote_aggregate = False
 
     # -- cross-step state ---------------------------------------------------
     def init_state(self, x: jax.Array) -> State:

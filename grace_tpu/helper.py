@@ -121,6 +121,10 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
         return comm.Allgather(axis_name=axis)
     if name == "broadcast":
         return comm.Broadcast(axis_name=axis)
+    if name in ("sign_allreduce", "signallreduce"):
+        return comm.SignAllreduce(
+            axis_name=axis,
+            vote_dtype=params.get("vote_dtype", "bfloat16"))
     if name in ("identity", "none"):
         return comm.Identity(axis_name=axis)
     raise ValueError(f"unknown communicator {name!r}")

@@ -91,6 +91,40 @@ def test_broadcast_equals_allgather(mesh, rng):
     np.testing.assert_array_equal(a, b)
 
 
+def test_sign_allreduce_matches_allgather_majority(mesh, rng):
+    """psum-based majority vote == allgather + SignSGD.aggregate (SURVEY.md
+    §7 hard part 4) — same result, fixed-cost collective."""
+    x = rng.normal(size=(W, 33)).astype(np.float32)
+    comp = C.SignSGDCompressor()
+    via_gather = run_exchange(mesh, comm.Allgather(), comp, jnp.asarray(x))
+    via_psum = run_exchange(mesh, comm.SignAllreduce(), comp, jnp.asarray(x))
+    np.testing.assert_array_equal(via_gather, via_psum)
+    assert set(np.unique(via_psum)) <= {-1.0, 1.0}
+
+
+def test_sign_allreduce_rejects_non_vote_compressors(mesh, rng):
+    import pytest
+    x = rng.normal(size=(W, 16)).astype(np.float32)
+    with pytest.raises(TypeError, match="majority-vote"):
+        run_exchange(mesh, comm.SignAllreduce(), C.TopKCompressor(0.5),
+                     jnp.asarray(x))
+    # average=False is NOT sufficient: EF-SignSGD's aggregate divides by lr,
+    # which the re-sign would silently drop.
+    with pytest.raises(TypeError, match="majority-vote"):
+        run_exchange(mesh, comm.SignAllreduce(), C.EFSignSGDCompressor(),
+                     jnp.asarray(x))
+
+
+def test_sign_allreduce_from_params(mesh, rng):
+    from grace_tpu import grace_from_params
+    g = grace_from_params({"compressor": "signum",
+                           "communicator": "sign_allreduce"})
+    assert isinstance(g.communicator, comm.SignAllreduce)
+    x = rng.normal(size=(W, 16)).astype(np.float32)
+    out = run_exchange(mesh, g.communicator, g.compressor, jnp.asarray(x))
+    assert set(np.unique(out)) <= {-1.0, 1.0}
+
+
 def test_powersgd_inside_compress(mesh, rng):
     """PowerSGD's collectives run inside compress; empty payload path."""
     x = rng.normal(size=(W, 12, 6)).astype(np.float32)
