@@ -10,7 +10,8 @@ reference.
 """
 
 from grace_tpu.core import Communicator, Compressor, Memory
-from grace_tpu.comm import Allgather, Allreduce, Broadcast, Identity
+from grace_tpu.comm import (Allgather, Allreduce, Broadcast, Identity,
+                            SignAllreduce)
 from grace_tpu.helper import Grace, grace_from_params
 from grace_tpu.transform import GraceState, grace_transform
 from grace_tpu.train import (TrainState, init_train_state, make_eval_step,
@@ -21,7 +22,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Communicator", "Compressor", "Memory",
-    "Allreduce", "Allgather", "Broadcast", "Identity",
+    "Allreduce", "Allgather", "Broadcast", "Identity", "SignAllreduce",
     "Grace", "grace_from_params", "grace_transform", "GraceState",
     "TrainState", "init_train_state", "make_train_step", "make_eval_step",
     "data_parallel_mesh", "make_mesh",

@@ -30,17 +30,53 @@ __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
            "SignAllreduce"]
 
 
+def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
+                        axis_name: str, vote_dtype: str) -> jax.Array:
+    """Decompress this rank's ±1 signs, psum, re-sign: exact majority vote
+    at fixed (world-size-independent) collective cost — SURVEY.md §7 hard
+    part 4. Shared by SignAllreduce and the Allreduce vote routing."""
+    if vote_dtype == "bfloat16":
+        w = lax.axis_size(axis_name)       # static at trace time
+        if w > 256:
+            raise ValueError(
+                f"vote_dtype='bfloat16' is integer-exact only up to world "
+                f"size 256; this axis has {w} — use vote_dtype='float32'.")
+    dec = compressor.decompress(payload, ctx)
+    summed = lax.psum(dec.astype(vote_dtype), axis_name)
+    out = (summed >= 0).astype(vote_dtype) * 2 - 1
+    return out.astype(dec.dtype)
+
+
 @dataclasses.dataclass(frozen=True)
 class Allreduce(Communicator):
     """Sum payloads across ranks, then decompress once.
 
     Mirrors grace_dl/dist/communicator/allreduce.py:6-13: all-reduce each
     payload tensor, divide by world size if ``compressor.average``, then
-    decompress the summed payload. Valid only for linear codecs.
+    decompress the summed payload. Valid only for linear codecs — and unlike
+    the reference, which merely documents that (IMPLEMENTING.md:43-45) and
+    psums e.g. Top-K values belonging to different indices without complaint,
+    this enforces ``compressor.summable_payload``. Majority-vote compressors
+    (``vote_aggregate=True``: signsgd, signum) are legal here too and are
+    routed through the fixed-cost psum vote (:class:`SignAllreduce`
+    semantics) — psumming their packed sign *bytes* would be garbage.
     """
+
+    vote_dtype: str = "bfloat16"
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:
+        if getattr(compressor, "vote_aggregate", False):
+            return _psum_majority_vote(payload, ctx, compressor,
+                                       self.axis_name, self.vote_dtype)
+        if not getattr(compressor, "summable_payload", False):
+            raise TypeError(
+                f"Allreduce requires a payload that sums meaningfully across "
+                f"ranks; {type(compressor).__name__} does not declare "
+                "summable_payload=True (its per-rank payloads decode "
+                "differently, e.g. per-rank indices or norms). Use "
+                "Allgather/Broadcast instead — reference compatibility "
+                "matrix, IMPLEMENTING.md:43-45.")
         summed = tuple(lax.psum(t, self.axis_name) for t in payload)
         if compressor.average and payload:
             if not all(jnp.issubdtype(t.dtype, jnp.inexact) for t in summed):
@@ -125,17 +161,8 @@ class SignAllreduce(Communicator):
                 f"{type(compressor).__name__} does not declare "
                 "vote_aggregate=True (its aggregate carries scaling the "
                 "re-sign would drop) — use Allreduce/Allgather instead.")
-        if self.vote_dtype == "bfloat16":
-            w = jax.lax.axis_size(self.axis_name)   # static at trace time
-            if w > 256:
-                raise ValueError(
-                    f"vote_dtype='bfloat16' is integer-exact only up to "
-                    f"world size 256; this axis has {w} — use "
-                    "SignAllreduce(vote_dtype='float32').")
-        dec = compressor.decompress(payload, ctx)
-        summed = lax.psum(dec.astype(self.vote_dtype), self.axis_name)
-        out = (summed >= 0).astype(self.vote_dtype) * 2 - 1
-        return out.astype(dec.dtype)
+        return _psum_majority_vote(payload, ctx, compressor,
+                                   self.axis_name, self.vote_dtype)
 
 
 @dataclasses.dataclass(frozen=True)

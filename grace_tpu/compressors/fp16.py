@@ -19,6 +19,7 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 @dataclasses.dataclass(frozen=True)
 class FP16Compressor(Compressor):
     dtype: str = "bfloat16"
+    summable_payload = True
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

@@ -20,6 +20,7 @@ class NoneCompressor(Compressor):
     """
 
     average: bool = True
+    summable_payload = True
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

@@ -50,6 +50,10 @@ class PowerSGDCompressor(Compressor):
     rank: int = 1
     warm_start: bool = True
     axis_name: str = DEFAULT_AXIS
+    # 1-D leaves ride the communicator dense; >=2-D leaves were already
+    # psum-reduced inside compress, so the outer allreduce sees a replicated
+    # payload that sums/averages consistently.
+    summable_payload = True
 
     def _factor_shapes(self, x: jax.Array):
         m = x.shape[-1]            # output-channel dim (HWIO/(*, features))

@@ -25,6 +25,9 @@ from grace_tpu.compressors.topk import static_k
 @dataclasses.dataclass(frozen=True)
 class RandomKCompressor(Compressor):
     compress_ratio: float = 0.3
+    # Indices come from a shared fold_in key, so every rank selects the same
+    # entries and payload values sum meaningfully (reference randomk.py:26-29).
+    summable_payload = True
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

@@ -64,12 +64,21 @@ class Compressor:
       vote over ±1 decompressed tensors (signsgd/signum). Gates the
       psum-based :class:`~grace_tpu.comm.SignAllreduce` communicator, which
       re-signs the sum and would silently drop any other aggregate's
-      scaling (e.g. EF-SignSGD's 1/lr).
+      scaling (e.g. EF-SignSGD's 1/lr); the generic ``Allreduce`` also
+      routes vote compressors through that psum-vote path.
+    * ``summable_payload`` — True iff summing payloads element-wise across
+      ranks then decompressing once equals decompress-each-then-aggregate,
+      i.e. the codec is linear in the payload (none, fp16/bf16, randomk —
+      shared indices; powersgd sums inside compress). The reference only
+      *documents* this compatibility matrix (IMPLEMENTING.md:43-45) and
+      silently corrupts gradients for e.g. topk+Allreduce; here ``Allreduce``
+      enforces it. Default False: a new codec must opt in.
     """
 
     average = True
     tensors_size_are_same = True
     vote_aggregate = False
+    summable_payload = False
 
     # -- cross-step state ---------------------------------------------------
     def init_state(self, x: jax.Array) -> State:

@@ -116,7 +116,9 @@ def _build_memory(params: Dict[str, Any], axis: str) -> Memory:
 def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
     name = params.get("communicator", "allgather")
     if name == "allreduce":
-        return comm.Allreduce(axis_name=axis)
+        return comm.Allreduce(
+            axis_name=axis,
+            vote_dtype=params.get("vote_dtype", "bfloat16"))
     if name == "allgather":
         return comm.Allgather(axis_name=axis)
     if name == "broadcast":

@@ -115,6 +115,30 @@ def test_sign_allreduce_rejects_non_vote_compressors(mesh, rng):
                      jnp.asarray(x))
 
 
+def test_allreduce_routes_sign_methods_through_vote(mesh, rng):
+    """Regression: 'allreduce' + signsgd once psummed the packed sign BYTES
+    and decompressed the byte-sum — garbage votes that made toy training
+    climb. The generic Allreduce must route vote_aggregate compressors
+    through the psum majority vote (== allgather + aggregate)."""
+    x = rng.normal(size=(W, 33)).astype(np.float32)
+    comp = C.SignSGDCompressor()
+    via_gather = run_exchange(mesh, comm.Allgather(), comp, jnp.asarray(x))
+    via_allreduce = run_exchange(mesh, comm.Allreduce(), comp, jnp.asarray(x))
+    np.testing.assert_array_equal(via_gather, via_allreduce)
+
+
+def test_allreduce_rejects_non_summable_payloads(mesh, rng):
+    """The reference only documents the Allreduce compatibility matrix
+    (IMPLEMENTING.md:43-45) and silently sums Top-K values belonging to
+    different per-rank indices; here the combination is a TypeError."""
+    import pytest
+    x = rng.normal(size=(W, 16)).astype(np.float32)
+    for comp in [C.TopKCompressor(0.5), C.QSGDCompressor(),
+                 C.OneBitCompressor(), C.EFSignSGDCompressor()]:
+        with pytest.raises(TypeError, match="summable_payload"):
+            run_exchange(mesh, comm.Allreduce(), comp, jnp.asarray(x))
+
+
 def test_sign_allreduce_from_params(mesh, rng):
     from grace_tpu import grace_from_params
     g = grace_from_params({"compressor": "signum",
