@@ -80,6 +80,8 @@ def setup_platform(platform: str):
         # jax onto the TPU tunnel, so env vars alone are not enough.
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", 8)
+        from grace_tpu.parallel import relax_cpu_collective_timeouts
+        relax_cpu_collective_timeouts()  # 8 device threads, few-core host
     devices = jax.devices()
     if platform == "tpu" and devices[0].platform != "tpu":
         raise RuntimeError(f"wanted tpu, got {devices[0].platform}")

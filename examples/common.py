@@ -26,6 +26,10 @@ if _REPO_ROOT not in sys.path:
 # an accelerator platform (ignoring the env var set at launch). Re-asserting
 # via jax.config is legal until the first backend initializes, so it must
 # happen here — before any grace_tpu/jax device touch.
+from grace_tpu.parallel import relax_cpu_collective_timeouts
+
+relax_cpu_collective_timeouts()  # N device threads on a few-core host
+
 if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
     import re as _re
 

@@ -32,12 +32,15 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from grace_tpu.core import Compressor, Ctx, Payload, State
 
 _MANT_BITS = 23
-_MARKER = jnp.uint32(1 << 22)
+_MARKER = np.uint32(1 << 22)  # np, not jnp: a module-level jnp
+# scalar would initialize the jax backend at import time, foreclosing
+# platform selection (e.g. the CPU-mesh pinning in tests/dryrun).
 
 
 def _floor_log2(x: jax.Array) -> jax.Array:

@@ -27,6 +27,7 @@ reads realise it — that is the `synchronize` point.
 from __future__ import annotations
 
 import warnings
+from collections import Counter
 from typing import Optional
 
 import jax
@@ -63,16 +64,32 @@ class GraceBridge:
             raise ValueError(f"mesh has no axis {self.axis!r}; "
                              f"axes: {tuple(self.mesh.shape)}")
         self.world = self.mesh.shape[self.axis]
-        self._local_rows = max(
-            1, len([d for d in self.mesh.devices.flat
-                    if d.process_index == jax.process_index()]))
-        if (self._local_rows > 1 and not grace.compressor.average):
-            warnings.warn(
-                "GraceBridge: this process feeds multiple mesh devices and "
-                f"the compressor has average=False (sum semantics): the "
-                "aggregate is scaled by the per-process duplication factor "
-                f"{self._local_rows}. Use one process per device for exact "
-                "sum semantics.")
+        rows_per_proc = Counter(d.process_index
+                                for d in self.mesh.devices.flat)
+        self._local_rows = max(1, rows_per_proc.get(jax.process_index(), 0))
+        if max(rows_per_proc.values()) > 1 and not grace.compressor.average:
+            uniform = len(set(rows_per_proc.values())) == 1
+            if getattr(grace.compressor, "vote_aggregate", False):
+                # A *uniform* duplication factor leaves a majority vote
+                # unchanged (every process casts k identical ballots, the
+                # re-signed sum is scale-free). Unequal factors weight the
+                # vote by local device count — warn on EVERY process, the
+                # biased aggregate reaches all of them.
+                if not uniform:
+                    warnings.warn(
+                        "GraceBridge: processes feed unequal numbers of mesh "
+                        f"devices ({sorted(rows_per_proc.values())}); each "
+                        "process's identical sign votes are duplicated by "
+                        "its local device count, biasing the majority vote "
+                        "toward larger processes. Use one process per device "
+                        "for an unweighted vote.")
+            else:
+                warnings.warn(
+                    "GraceBridge: some process feeds multiple mesh devices "
+                    "and the compressor has average=False (sum semantics): "
+                    "duplicated rows scale the aggregate (per-process "
+                    f"duplication factors {sorted(rows_per_proc.values())}). "
+                    "Use one process per device for exact sum semantics.")
 
         tx = grace.transform(seed=seed)
         template = jnp.zeros((self.n,), self.dtype)

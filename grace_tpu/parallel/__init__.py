@@ -25,7 +25,35 @@ from grace_tpu.core import DEFAULT_AXIS
 
 __all__ = ["DEFAULT_AXIS", "data_parallel_mesh", "make_mesh",
            "initialize_distributed", "replicated", "batch_sharded",
-           "local_world_size", "broadcast_tree", "metric_average"]
+           "local_world_size", "broadcast_tree", "metric_average",
+           "relax_cpu_collective_timeouts"]
+
+
+def relax_cpu_collective_timeouts(warn_s: int = 300,
+                                  terminate_s: int = 1200) -> None:
+    """Raise XLA:CPU's in-process collective rendezvous timeouts.
+
+    The simulated N-device CPU mesh runs each "device" as a host thread; on
+    a host with few cores (this dev image has ONE) a heavy step can keep
+    half the device threads from reaching an all-reduce rendezvous within
+    XLA's default 20s warn / 40s terminate window, which kills the process
+    mid-collective (seen: LeNet/MNIST on the 8-device mesh). XLA reads
+    these flags from $XLA_FLAGS at backend initialization, so call this
+    before the first `jax.devices()` — importing jax earlier is fine.
+    No-op for flags the caller already set explicitly.
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    extra = []
+    if "--xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
+        extra.append("--xla_cpu_collective_call_warn_stuck_timeout_seconds"
+                     f"={warn_s}")
+    if "--xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+        extra.append("--xla_cpu_collective_call_terminate_timeout_seconds"
+                     f"={terminate_s}")
+    if extra:
+        os.environ["XLA_FLAGS"] = " ".join([flags, *extra]).strip()
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,

@@ -21,7 +21,12 @@ jax.config.update("jax_num_cpu_devices", 8)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-from grace_tpu.parallel import data_parallel_mesh  # noqa: E402
+from grace_tpu.parallel import (data_parallel_mesh,  # noqa: E402
+                                relax_cpu_collective_timeouts)
+
+# 8 device threads on a possibly 1-core host: don't let XLA's 40s collective
+# rendezvous terminate-timeout kill a slow-but-healthy test step.
+relax_cpu_collective_timeouts()
 
 
 @pytest.fixture(scope="session")

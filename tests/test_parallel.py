@@ -5,11 +5,29 @@ are identity/mean there by contract); the multi-process branch is the thin
 multihost_utils call, which cannot run in a single-process suite.
 """
 
+import subprocess
+import sys
+
 import jax.numpy as jnp
 import numpy as np
 
 from grace_tpu.parallel import broadcast_tree, metric_average
 from grace_tpu.train import warmup_schedule
+
+
+def test_import_does_not_initialize_backend():
+    """Regression: a module-level `jnp.uint32(...)` constant once made
+    `import grace_tpu` initialize the jax backend, foreclosing platform
+    selection (the CPU-mesh pinning in conftest/dryrun/examples) and
+    `jax.distributed.initialize` — and hanging outright when the default
+    platform's tunnel was unhealthy. Library import must stay device-free."""
+    code = ("import grace_tpu; from jax._src import xla_bridge; "
+            "raise SystemExit(1 if xla_bridge._backends else 0)")
+    proc = subprocess.run([sys.executable, "-c", code], timeout=120,
+                          capture_output=True, text=True,
+                          env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                               "PYTHONPATH": ":".join(sys.path)})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
 
 
 class TestBroadcastTree:

@@ -90,6 +90,18 @@ def test_signsgd_converges(mesh):
     assert losses[-1] < losses[0] * 0.8
 
 
+def test_signsgd_allreduce_converges_and_matches_allgather(mesh):
+    """Regression (round 2): signsgd + 'allreduce' once psummed packed sign
+    bytes and training climbed. The vote routing must give the exact same
+    trajectory as the allgather majority vote — the pipeline is
+    deterministic, so equality is step-exact."""
+    cfg = {"compressor": "signsgd", "memory": "none"}
+    via_gather = train(mesh, {**cfg, "communicator": "allgather"}, lr=0.02)
+    via_reduce = train(mesh, {**cfg, "communicator": "allreduce"}, lr=0.02)
+    assert via_reduce[-1] < via_reduce[0] * 0.8
+    np.testing.assert_allclose(via_reduce, via_gather, rtol=1e-6)
+
+
 def test_efsignsgd_converges(mesh):
     losses = train(mesh, {"compressor": "efsignsgd", "memory": "efsignsgd",
                           "lr": 0.1, "communicator": "allgather"}, lr=1.0)
