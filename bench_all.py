@@ -67,6 +67,14 @@ CONFIGS = [
                                       "memory": "residual",
                                       "communicator": "allgather",
                                       "fusion": "flat"}},
+    # Two-shot scatter-reduce-recompress all-reduce: O(k) wire per rank vs
+    # allgather's O(W·k) (see comm.TwoShotAllreduce).
+    {"name": "topk1pct_twoshot", "params": {"compressor": "topk",
+                                            "compress_ratio": 0.01,
+                                            "topk_algorithm": "approx",
+                                            "memory": "residual",
+                                            "communicator": "twoshot",
+                                            "fusion": "flat"}},
     # Fusion ablation (headline pair unfused, and Horovod's default 64 MiB
     # bucketing — SURVEY.md §2.4):
     {"name": "none_unfused", "params": {"compressor": "none",

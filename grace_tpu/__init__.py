@@ -11,7 +11,7 @@ reference.
 
 from grace_tpu.core import Communicator, Compressor, Memory
 from grace_tpu.comm import (Allgather, Allreduce, Broadcast, Identity,
-                            SignAllreduce)
+                            SignAllreduce, TwoShotAllreduce)
 from grace_tpu.helper import Grace, grace_from_params
 from grace_tpu.transform import GraceState, grace_transform
 from grace_tpu.train import (TrainState, init_train_state, make_eval_step,
@@ -23,6 +23,7 @@ __version__ = "0.1.0"
 __all__ = [
     "Communicator", "Compressor", "Memory",
     "Allreduce", "Allgather", "Broadcast", "Identity", "SignAllreduce",
+    "TwoShotAllreduce",
     "Grace", "grace_from_params", "grace_transform", "GraceState",
     "TrainState", "init_train_state", "make_train_step", "make_eval_step",
     "data_parallel_mesh", "make_mesh",

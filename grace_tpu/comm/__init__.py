@@ -27,7 +27,7 @@ from jax import lax
 from grace_tpu.core import Communicator, Compressor, Ctx, Payload
 
 __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
-           "SignAllreduce"]
+           "SignAllreduce", "TwoShotAllreduce"]
 
 
 def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
@@ -163,6 +163,144 @@ class SignAllreduce(Communicator):
                 "re-sign would drop) — use Allreduce/Allgather instead.")
         return _psum_majority_vote(payload, ctx, compressor,
                                    self.axis_name, self.vote_dtype)
+
+
+def _split_ctx(ctx):
+    """Partition a ctx pytree into (treedef, [leaf|None static], [arrays])."""
+    leaves, treedef = jax.tree_util.tree_flatten(ctx)
+    is_arr = [isinstance(l, (jax.Array, jnp.ndarray)) for l in leaves]
+    static = [None if a else l for a, l in zip(is_arr, leaves)]
+    arrays = [l for a, l in zip(is_arr, leaves) if a]
+    return treedef, static, arrays
+
+
+def _join_ctx(treedef, static, arrays):
+    arrays = iter(arrays)
+    leaves = [next(arrays) if s is None else s for s in static]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@dataclasses.dataclass(frozen=True)
+class _ChunkedView:
+    """Decompress-only adapter: (w, …) stacked chunk payloads → full leaf.
+
+    Lets every Memory's ``update`` (which only ever calls
+    ``compressor.decompress``) compute the stage-1 residual/keep-mask of the
+    two-shot pipeline without knowing about chunking."""
+
+    inner: Compressor
+
+    def decompress(self, payload: Payload, ctx) -> jax.Array:
+        treedef, static, arr_stack, n, shape, dtype = ctx
+
+        def dec(p, arrs):
+            return self.inner.decompress(p, _join_ctx(treedef, static, arrs))
+
+        chunks = jax.vmap(dec)(payload, arr_stack)      # (w, m)
+        return chunks.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoShotAllreduce(Communicator):
+    """Scatter–reduce–(re)compress all-reduce: O(k) wire per rank.
+
+    The reference's only general communicator, allgather, costs every rank
+    (W−1)·k received payload bytes — linear in world size
+    (grace_dl/dist/communicator/allgather.py:7-45). The standard fix in the
+    compression literature (ScaleCom's scatter-reduce, arXiv:2104.11125;
+    DynamiQ's multi-hop compressed all-reduce, arXiv:2602.08923; EQuARX's
+    quantized XLA all-reduce, arXiv:2506.17615) is a two-shot scheme, which
+    XLA collectives express directly inside shard_map:
+
+    1. split the compensated gradient into W equal chunks; compress each
+       with a chunk-folded shared rng;
+    2. ``all_to_all`` the stacked chunk payloads — rank i receives every
+       rank's payload for chunk i (wire ≈ k);
+    3. decompress + ``aggregate`` (sum / majority vote) the owned chunk,
+       divide by W if ``compressor.average``;
+    4. re-compress the aggregated chunk (shared stage-2 rng) and
+       ``all_gather`` it (wire ≈ k); every rank decodes all W chunk
+       aggregates and concatenates.
+
+    Total ≈ 2k per rank vs allgather's (W−1)k: break-even at W=3, ~4× at
+    W=8, ~100× on a 256-chip pod. Cost: the aggregate is compressed once
+    more (stage-2 loss, not covered by error feedback — ScaleCom §III
+    discusses why this is benign for mean-like aggregates), and selection
+    codecs select per chunk rather than globally (same trade as
+    ``topk_algorithm='chunk'``).
+
+    Works with any *stateless* codec (stateful ones — signum momentum,
+    powersgd Q — hold full-tensor state that has no per-chunk meaning and
+    are rejected; powersgd's in-compress psum makes two-shot moot anyway).
+    All memories compose: ``update`` sees a stage-1 reconstruction via
+    :class:`_ChunkedView`.
+    """
+
+    def step(self, x: jax.Array, mem_state, comp_state,
+             memory, compressor: Compressor, rng: jax.Array):
+        if comp_state is not None:
+            raise TypeError(
+                f"TwoShotAllreduce requires a stateless compressor; "
+                f"{type(compressor).__name__} carries cross-step state "
+                "(init_state != None) that has no per-chunk meaning — use "
+                "Allgather/Allreduce instead.")
+        w = lax.axis_size(self.axis_name)               # static at trace time
+        shape, dtype = x.shape, x.dtype
+        compensated, mem_state = memory.compensate(x, mem_state)
+        flat = compensated.reshape(-1)
+        n = flat.size
+        chunks = jnp.pad(flat, (0, (-n) % w)).reshape(w, -1)
+
+        # Stage 1: per-chunk compress under a chunk-folded shared key. One
+        # probe call pins the (chunk-uniform) static ctx structure; vmap
+        # carries the array leaves.
+        probe_payload, probe_ctx, _ = compressor.compress(
+            chunks[0], None, jax.random.fold_in(rng, 0))
+        if not probe_payload:
+            raise TypeError(
+                f"TwoShotAllreduce needs a wire payload to scatter; "
+                f"{type(compressor).__name__} communicates inside compress "
+                "— use Allreduce instead.")
+        treedef, static, _ = _split_ctx(probe_ctx)
+
+        def comp_one(chunk, c):
+            payload, ctx, _ = compressor.compress(
+                chunk, None, jax.random.fold_in(rng, c))
+            _, _, arrays = _split_ctx(ctx)
+            return tuple(payload), tuple(arrays)
+
+        payloads, ctx_arrays = jax.vmap(comp_one)(chunks, jnp.arange(w))
+
+        view_ctx = (treedef, static, ctx_arrays, n, shape, dtype)
+        mem_state = memory.update(compensated, payloads, view_ctx,
+                                  _ChunkedView(compressor), mem_state)
+
+        # Stage 2: swap chunk axis for world axis; aggregate the owned chunk.
+        i = lax.axis_index(self.axis_name)
+        mine = tuple(lax.all_to_all(p, self.axis_name, 0, 0) for p in payloads)
+        my_ctx = _join_ctx(treedef, static,
+                           [jnp.take(a, i, axis=0) for a in ctx_arrays])
+        stacked = jax.vmap(lambda p: compressor.decompress(p, my_ctx))(mine)
+        agg = compressor.aggregate(stacked)
+        if compressor.average:
+            agg = agg / w
+
+        # Stage 3: re-compress the aggregate (shared stage-2 key: ctx must
+        # be chunk-index-independent so every rank can decode every chunk),
+        # all-gather, decode, reassemble.
+        payload2, ctx2, _ = compressor.compress(
+            agg.astype(chunks.dtype), None, jax.random.fold_in(rng, w))
+        gathered = tuple(lax.all_gather(p, self.axis_name, axis=0, tiled=False)
+                         for p in payload2)
+        out = jax.vmap(lambda p: compressor.decompress(p, ctx2))(gathered)
+        out = out.reshape(-1)[:n].reshape(shape).astype(dtype)
+        return out, mem_state, comp_state
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        raise TypeError("TwoShotAllreduce re-chunks the gradient before "
+                        "compression; it only supports the full step() "
+                        "pipeline, not a bare exchange().")
 
 
 @dataclasses.dataclass(frozen=True)

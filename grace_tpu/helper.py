@@ -123,6 +123,8 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
         return comm.Allgather(axis_name=axis)
     if name == "broadcast":
         return comm.Broadcast(axis_name=axis)
+    if name in ("twoshot", "twoshot_allreduce"):
+        return comm.TwoShotAllreduce(axis_name=axis)
     if name in ("sign_allreduce", "signallreduce"):
         return comm.SignAllreduce(
             axis_name=axis,

@@ -73,11 +73,17 @@ CONFIGS = [
      "communicator": "allgather"},
     {"compressor": "inceptionn", "memory": "none",
      "communicator": "allgather"},
+    # Two-shot scatter-reduce-recompress path (O(k) wire per rank).
+    {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+     "communicator": "twoshot"},
+    {"compressor": "qsgd", "quantum_num": 64, "memory": "none",
+     "communicator": "twoshot"},
 ]
 
 
-@pytest.mark.parametrize("cfg", CONFIGS,
-                         ids=[c["compressor"] for c in CONFIGS])
+@pytest.mark.parametrize(
+    "cfg", CONFIGS,
+    ids=[f"{c['compressor']}-{c['communicator']}" for c in CONFIGS])
 def test_training_converges(mesh, cfg):
     losses = train(mesh, cfg)
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
