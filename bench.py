@@ -194,6 +194,27 @@ def bench_configs(platform: str, configs, emit) -> None:
         rep = wire_report(grace.compressor, params)
         return rep.dense_bytes, rep.wire_bytes
 
+    def recv_bytes(grace, payload_b, n_elems, w):
+        """Received bytes per rank per step for this mesh — the
+        communicator-aware number (payload_b alone is communicator-blind
+        and cannot show e.g. twoshot's O(k) vs allgather's O(W·k)).
+        Ring model for the reduce-style collectives."""
+        from grace_tpu.comm import (Allgather, Allreduce, SignAllreduce,
+                                    TwoShotAllreduce)
+        c = grace.communicator
+        if isinstance(c, TwoShotAllreduce):
+            # stage-1 all_to_all + stage-2 all_gather, each ~payload_b·(W-1)/W
+            return 2 * payload_b * (w - 1) // max(1, w)
+        vote = getattr(grace.compressor, "vote_aggregate", False)
+        if isinstance(c, SignAllreduce) or (isinstance(c, Allreduce) and vote):
+            # psum of dense ±1 votes in bf16 (2 bytes), ring: 2·(W-1)/W·n·2
+            return 2 * 2 * n_elems * (w - 1) // max(1, w)
+        if isinstance(c, Allreduce):
+            return 2 * payload_b * (w - 1) // max(1, w)
+        if isinstance(c, Allgather):   # Broadcast subclasses Allgather
+            return payload_b * (w - 1)
+        return 0                       # Identity
+
     print(f"[bench] mesh: {len(devices)}x {devices[0].platform}",
           file=sys.stderr, flush=True)
     baseline = None
@@ -215,6 +236,10 @@ def bench_configs(platform: str, configs, emit) -> None:
             "vs_baseline": round(best / baseline, 4),
             "wire_bytes_per_step": wire_b,
             "wire_ratio": round(wire_b / max(1, dense_b), 6),
+            "wire_recv_bytes_per_step": recv_bytes(
+                grace, wire_b,
+                sum(l.size for l in jax.tree_util.tree_leaves(params)),
+                len(devices)),
             "platform": devices[0].platform,
             "n_devices": len(devices),
         })
@@ -325,6 +350,38 @@ def orchestrate(script_path: str, parse, emit_failure,
     return False
 
 
+# Last successful on-TPU headline result, committed as evidence: the tunnel
+# to the single real chip has been observed to stay unreachable for hours at
+# a stretch, so a CPU-fallback (or failed) run carries the most recent real
+# number along, clearly labeled with its capture time.
+TPU_EVIDENCE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_TPU_LAST.json")
+
+
+def save_tpu_evidence(result: dict) -> None:
+    if result.get("platform") != "tpu":
+        return
+    import datetime
+    rec = dict(result)
+    rec["captured_at"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    try:
+        with open(TPU_EVIDENCE_PATH, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"[bench] could not save TPU evidence: {e}",
+              file=sys.stderr, flush=True)
+
+
+def load_tpu_evidence():
+    try:
+        with open(TPU_EVIDENCE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def main() -> None:
     here = os.path.abspath(__file__)
 
@@ -332,15 +389,25 @@ def main() -> None:
         result = _last_json_line(out)
         if result:
             result["stages"] = stages
+            if result.get("platform") == "tpu":
+                save_tpu_evidence(result)
+            else:
+                last = load_tpu_evidence()
+                if last:
+                    result["last_tpu"] = last
             print(json.dumps(result), flush=True)
         return result
 
     def emit_failure(stages):
-        print(json.dumps({
+        out = {
             "metric": "resnet50_topk1pct_imgs_per_sec",
             "value": None, "unit": "imgs/sec", "vs_baseline": None,
             "stages": stages,
-        }), flush=True)
+        }
+        last = load_tpu_evidence()
+        if last:
+            out["last_tpu"] = last
+        print(json.dumps(out), flush=True)
 
     if not orchestrate(here, parse, emit_failure):
         sys.exit(1)
