@@ -186,18 +186,26 @@ class _ChunkedView:
 
     Lets every Memory's ``update`` (which only ever calls
     ``compressor.decompress``) compute the stage-1 residual/keep-mask of the
-    two-shot pipeline without knowing about chunking."""
+    two-shot pipeline without knowing about chunking. With stage-2 feedback,
+    the owner's re-compression error is subtracted at the owned chunk so a
+    residual-style memory (``compensated − decompress``) accumulates it."""
 
     inner: Compressor
 
     def decompress(self, payload: Payload, ctx) -> jax.Array:
-        treedef, static, arr_stack, n, shape, dtype = ctx
+        treedef, static, arr_stack, n, shape, dtype, stage2 = ctx
 
         def dec(p, arrs):
             return self.inner.decompress(p, _join_ctx(treedef, static, arrs))
 
         chunks = jax.vmap(dec)(payload, arr_stack)      # (w, m)
-        return chunks.reshape(-1)[:n].reshape(shape).astype(dtype)
+        flat = chunks.reshape(-1)
+        if stage2 is not None:
+            e2, start = stage2                          # own-chunk error (m,)
+            flat = lax.dynamic_update_slice(
+                flat, lax.dynamic_slice(flat, (start,), e2.shape)
+                - e2.astype(flat.dtype), (start,))
+        return flat[:n].reshape(shape).astype(dtype)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -234,7 +242,19 @@ class TwoShotAllreduce(Communicator):
     are rejected; powersgd's in-compress psum makes two-shot moot anyway).
     All memories compose: ``update`` sees a stage-1 reconstruction via
     :class:`_ChunkedView`.
+
+    ``stage2_feedback=True`` (ScaleCom's chunk-owner error feedback,
+    arXiv:2104.11125 §III) additionally folds each owner's stage-2
+    re-compression error into its residual at the owned chunk, so a
+    residual-style memory corrects it on later steps — each chunk has a
+    fixed owner, so the whole stage-2 error is covered exactly once across
+    ranks. Requires a memory whose update is ``compensated − decompress``
+    (Residual/EFSignSGD/PowerSGD-style); DgcMemory interprets nonzero
+    decompressed lanes as "transmitted" and would wrongly clear its
+    accumulators over the whole owned chunk, so it is rejected.
     """
+
+    stage2_feedback: bool = False
 
     def step(self, x: jax.Array, mem_state, comp_state,
              memory, compressor: Compressor, rng: jax.Array):
@@ -271,9 +291,15 @@ class TwoShotAllreduce(Communicator):
 
         payloads, ctx_arrays = jax.vmap(comp_one)(chunks, jnp.arange(w))
 
-        view_ctx = (treedef, static, ctx_arrays, n, shape, dtype)
-        mem_state = memory.update(compensated, payloads, view_ctx,
-                                  _ChunkedView(compressor), mem_state)
+        if self.stage2_feedback:
+            from grace_tpu.memories import DgcMemory
+            if isinstance(memory, DgcMemory):
+                raise TypeError(
+                    "TwoShotAllreduce(stage2_feedback=True) is incompatible "
+                    "with DgcMemory: its keep-mask reads decompress()==0 and "
+                    "the injected stage-2 error would clear the accumulators "
+                    "across the whole owned chunk. Use ResidualMemory or "
+                    "disable stage2_feedback.")
 
         # Stage 2: swap chunk axis for world axis; aggregate the owned chunk.
         i = lax.axis_index(self.axis_name)
@@ -288,8 +314,22 @@ class TwoShotAllreduce(Communicator):
         # Stage 3: re-compress the aggregate (shared stage-2 key: ctx must
         # be chunk-index-independent so every rank can decode every chunk),
         # all-gather, decode, reassemble.
+        agg = agg.astype(chunks.dtype)
         payload2, ctx2, _ = compressor.compress(
-            agg.astype(chunks.dtype), None, jax.random.fold_in(rng, w))
+            agg, None, jax.random.fold_in(rng, w))
+
+        stage2 = None
+        if self.stage2_feedback:
+            e2 = agg - compressor.decompress(payload2, ctx2)
+            # A mean-aggregate dilutes a single owner's correction by 1/W;
+            # pre-scale so the error is repaid exactly once across ranks.
+            if compressor.average:
+                e2 = e2 * w
+            stage2 = (e2, i * chunks.shape[1])
+        view_ctx = (treedef, static, ctx_arrays, n, shape, dtype, stage2)
+        mem_state = memory.update(compensated, payloads, view_ctx,
+                                  _ChunkedView(compressor), mem_state)
+
         gathered = tuple(lax.all_gather(p, self.axis_name, axis=0, tiled=False)
                          for p in payload2)
         out = jax.vmap(lambda p: compressor.decompress(p, ctx2))(gathered)

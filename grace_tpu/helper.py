@@ -124,7 +124,9 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
     if name == "broadcast":
         return comm.Broadcast(axis_name=axis)
     if name in ("twoshot", "twoshot_allreduce"):
-        return comm.TwoShotAllreduce(axis_name=axis)
+        return comm.TwoShotAllreduce(
+            axis_name=axis,
+            stage2_feedback=bool(params.get("stage2_feedback", False)))
     if name in ("sign_allreduce", "signallreduce"):
         return comm.SignAllreduce(
             axis_name=axis,

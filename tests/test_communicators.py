@@ -267,3 +267,44 @@ class TestTwoShotAllreduce:
                                "memory": "residual",
                                "communicator": "twoshot"})
         assert isinstance(g.communicator, comm.TwoShotAllreduce)
+
+    def test_stage2_feedback_tightens_tracking(self, mesh, rng):
+        """ScaleCom-style owner error feedback: with stage2_feedback the
+        cumulative aggregated gradient tracks the allgather (single-loss)
+        trajectory at least as closely as without it."""
+        from grace_tpu.memories import ResidualMemory
+
+        def accumulate(communicator):
+            rng_local = np.random.default_rng(7)
+            grads = rng_local.normal(size=(6, W, 96)).astype(np.float32)
+            comp = C.TopKCompressor(compress_ratio=0.25)
+            memory = ResidualMemory()
+
+            def body(gs):
+                gs = gs[:, 0]                       # (steps, n) local grads
+                ms = memory.init_state(gs[0])
+                total = jnp.zeros_like(gs[0])
+                for t in range(gs.shape[0]):
+                    out, ms, _ = communicator.step(
+                        gs[t], ms, None, memory, comp, jax.random.key(t))
+                    total = total + out
+                return total[None]
+
+            fn = jax.shard_map(body, mesh=mesh, in_specs=P(None, "data"),
+                               out_specs=P("data"), check_vma=False)
+            return np.asarray(fn(jnp.asarray(grads))[0]), grads
+
+        got_fb, grads = accumulate(comm.TwoShotAllreduce(stage2_feedback=True))
+        got_no, _ = accumulate(comm.TwoShotAllreduce())
+        ref, _ = accumulate(comm.Allgather())   # single-compression reference
+        err_fb = np.linalg.norm(got_fb - ref)
+        err_no = np.linalg.norm(got_no - ref)
+        assert err_fb <= err_no + 1e-5, (err_fb, err_no)
+
+    def test_stage2_feedback_rejects_dgc_memory(self, mesh, rng):
+        import pytest
+        from grace_tpu.memories import DgcMemory
+        x = rng.normal(size=(W, 32)).astype(np.float32)
+        with pytest.raises(TypeError, match="stage2_feedback"):
+            run_step(mesh, comm.TwoShotAllreduce(stage2_feedback=True),
+                     C.TopKCompressor(0.25), DgcMemory(), jnp.asarray(x))
