@@ -26,7 +26,7 @@ import optax
 
 import common  # noqa: E402 — sys.path bootstrap so grace_tpu imports resolve
 from grace_tpu import grace_from_params
-from grace_tpu.models import resnet, transformer
+from grace_tpu.models import resnet, transformer, vgg
 from grace_tpu.parallel import (batch_sharded, data_parallel_mesh,
                                 initialize_distributed)
 from grace_tpu.train import (init_stateful_train_state,
@@ -36,14 +36,23 @@ from grace_tpu.utils import rank_zero_print, wire_report
 
 
 def build(args, mesh):
-    if args.model.startswith("resnet"):
-        depth = int(args.model[len("resnet"):])
-        params, mstate = resnet.init(jax.random.key(args.seed), depth=depth,
-                                     num_classes=args.num_classes)
+    if args.model.startswith("resnet") or args.model.startswith("vgg"):
+        prefix = "resnet" if args.model.startswith("resnet") else "vgg"
+        net = resnet if prefix == "resnet" else vgg
+        spec = args.model[len(prefix):]
+        kwargs = {}
+        if prefix == "vgg":
+            # torchvision naming: vgg16 is plain, vgg16_bn has BatchNorm
+            kwargs["batch_norm"] = spec.endswith("_bn")
+            spec = spec.removesuffix("_bn")
+        if not spec.isdigit():
+            raise SystemExit(f"unknown --model {args.model}")
+        params, mstate = net.init(jax.random.key(args.seed), depth=int(spec),
+                                  num_classes=args.num_classes, **kwargs)
 
         def loss_fn(params, mstate, batch):
             x, y = batch
-            logits, new_mstate = resnet.apply(
+            logits, new_mstate = net.apply(
                 params, mstate, x.astype(common.compute_dtype()), train=True)
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             return loss.mean(), new_mstate
@@ -80,7 +89,8 @@ def main():
     parser = argparse.ArgumentParser(description=__doc__)
     common.add_grace_args(parser)
     parser.add_argument("--model", default="resnet50",
-                        help="resnet50|resnet101|resnet152|bert")
+                        help="resnet50|resnet101|resnet152|vgg{11,13,16,19}"
+                             "[_bn]|bert")
     parser.add_argument("--batch-size", type=int, default=32,
                         help="per-device batch (reference default 32)")
     parser.add_argument("--image-size", type=int, default=224)
@@ -116,7 +126,7 @@ def main():
                       # waits for execution (block_until_ready returns early)
 
     items = batch[1].shape[0] * args.num_batches_per_iter
-    unit = "img" if "resnet" in args.model else "seq"
+    unit = "seq" if args.model == "bert" else "img"
     per_iter = []
     for i in range(args.num_iters):
         t0 = time.perf_counter()

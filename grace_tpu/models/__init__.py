@@ -10,8 +10,11 @@ checkpoints with orbax alongside them.
 * ``resnet``        — ResNet-50/101/152 v1.5 (torchvision stand-in used by
                       examples/torch/pytorch_synthetic_benchmark.py:49)
 * ``transformer``   — BERT-style encoder (BASELINE.json BERT/PowerSGD config)
+* ``vgg``           — VGG-11/13/16/19 (the communication-bound classic of the
+                      reference's synthetic-benchmark model list)
 """
 
-from grace_tpu.models import layers, lenet, resnet, resnet_cifar, transformer
+from grace_tpu.models import (layers, lenet, resnet, resnet_cifar,
+                              transformer, vgg)
 
-__all__ = ["layers", "lenet", "resnet", "resnet_cifar", "transformer"]
+__all__ = ["layers", "lenet", "resnet", "resnet_cifar", "transformer", "vgg"]

@@ -1,0 +1,98 @@
+"""VGG-11/13/16/19 (configurable BN) — synthetic-benchmark model family.
+
+Reference: examples/torch/pytorch_synthetic_benchmark.py:49 instantiates any
+torchvision model by name (``getattr(models, args.model)``) — vgg16 is the
+canonical non-residual CNN of that list, and its ~138M parameters (vs
+ResNet-50's 25.6M) make it the classic *communication-bound* benchmark:
+gradient exchange dominates, which is exactly the regime gradient
+compression targets. Architecture per Simonyan & Zisserman (arXiv:1409.1556):
+stacked 3x3 convs between 2x2 max-pools, then a 3-layer classifier head.
+TPU-first notes: NHWC layout, optional BatchNorm after every conv (the
+"_bn" torchvision variants), and the torchvision head exactly — features are
+adaptively pooled to the canonical 7x7 grid (static-shape `jax.image.resize`,
+so any input resolution >= 32 jits) and flattened to the 25088-wide fc1,
+keeping vgg16 at its full ~138M parameters: the point of VGG in a gradient-
+compression benchmark is precisely that communication-bound head. Logits are
+computed in float32 (zoo convention, cf. resnet.py / transformer.py) even
+under a bf16 compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.models import layers as L
+
+# Channel plans ('M' = 2x2 max-pool), arXiv:1409.1556 Table 1.
+_PLANS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    13: (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+def init(key: jax.Array, depth: int = 16, num_classes: int = 1000,
+         batch_norm: bool = True) -> Tuple[L.Params, L.ModelState]:
+    if depth not in _PLANS:
+        raise ValueError(f"vgg depth must be one of {sorted(_PLANS)}")
+    plan = _PLANS[depth]
+    n_convs = sum(1 for v in plan if v != "M")
+    keys = L.split_keys(key, n_convs + 3)
+    params: dict = {}
+    state: dict = {}
+    cin, ki = 3, 0
+    for li, v in enumerate(plan):
+        if v == "M":
+            continue
+        name = f"conv{li}"
+        params[name] = L.conv_init(keys[ki], 3, 3, cin, v,
+                                   use_bias=not batch_norm)
+        if batch_norm:
+            bn_p, bn_s = L.bn_init(v)
+            params[f"bn{li}"] = bn_p
+            state[f"bn{li}"] = bn_s
+        cin, ki = v, ki + 1
+    params["fc1"] = L.dense_init(keys[ki], 7 * 7 * 512, 4096)
+    params["fc2"] = L.dense_init(keys[ki + 1], 4096, 4096)
+    params["fc3"] = L.dense_init(keys[ki + 2], 4096, num_classes)
+    return params, state
+
+
+def apply(params: L.Params, state: L.ModelState, x: jax.Array, *,
+          train: bool = True, depth: int | None = None
+          ) -> Tuple[jax.Array, L.ModelState]:
+    """x: (N, H, W, 3), H=W>=32 → logits (N, num_classes).
+
+    ``depth`` is recovered from the params when omitted.
+    """
+    if depth is None:
+        n_convs = sum(1 for k in params if k.startswith("conv"))
+        depth = next(d for d, plan in _PLANS.items()
+                     if sum(1 for v in plan if v != "M") == n_convs)
+    new_state = dict(state)
+    for li, v in enumerate(_PLANS[depth]):
+        if v == "M":
+            x = L.max_pool(x, 2)
+            continue
+        x = L.conv_apply(params[f"conv{li}"], x, padding="SAME")
+        bn = f"bn{li}"
+        if bn in params:
+            x, new_state[bn] = L.bn_apply(params[bn], state[bn], x, train)
+        x = jax.nn.relu(x)
+    if x.shape[1] != 7 or x.shape[2] != 7:
+        # Adaptive pool to the canonical 7x7 grid (torchvision
+        # AdaptiveAvgPool2d((7, 7))): static shapes, any input size.
+        x = jax.image.resize(x, (x.shape[0], 7, 7, x.shape[3]),
+                             method="linear")
+    x = x.reshape(x.shape[0], -1)                 # (N, 25088)
+    x = jax.nn.relu(L.dense_apply(params["fc1"], x))
+    x = jax.nn.relu(L.dense_apply(params["fc2"], x))
+    x = x.astype(jnp.float32)                     # fp32 logits, zoo convention
+    return L.dense_apply(params["fc3"], x), new_state

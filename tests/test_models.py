@@ -107,3 +107,32 @@ def test_end_to_end_compressed_training(mesh, grace_params):
         ts, loss = step(ts, batch)
     assert jnp.isfinite(loss)
     assert float(loss) < float(first) * 0.5, (first, loss)
+
+
+def test_vgg_forward_and_state():
+    from grace_tpu.models import vgg
+    params, state = vgg.init(jax.random.key(0), depth=11, num_classes=7)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    logits, new_state = vgg.apply(params, state, x, train=True)
+    assert logits.shape == (2, 7)
+    # BN state updated in train mode
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(new_state)))
+    assert changed
+    # eval mode: state passes through untouched
+    _, eval_state = vgg.apply(params, new_state, x, train=False)
+    for a, b in zip(jax.tree_util.tree_leaves(new_state),
+                    jax.tree_util.tree_leaves(eval_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_vgg_depth_recovery_and_no_bn():
+    from grace_tpu.models import vgg
+    params, state = vgg.init(jax.random.key(1), depth=13, num_classes=3,
+                             batch_norm=False)
+    assert state == {}
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    logits, _ = vgg.apply(params, state, x, train=True)  # depth inferred
+    assert logits.shape == (1, 3)
