@@ -47,8 +47,12 @@ def build(args, mesh):
             spec = spec.removesuffix("_bn")
         if not spec.isdigit():
             raise SystemExit(f"unknown --model {args.model}")
-        params, mstate = net.init(jax.random.key(args.seed), depth=int(spec),
-                                  num_classes=args.num_classes, **kwargs)
+        try:
+            params, mstate = net.init(jax.random.key(args.seed),
+                                      depth=int(spec),
+                                      num_classes=args.num_classes, **kwargs)
+        except (ValueError, KeyError):   # unsupported depth (vgg15, resnet18)
+            raise SystemExit(f"unknown --model {args.model}")
 
         def loss_fn(params, mstate, batch):
             x, y = batch

@@ -8,13 +8,16 @@ gradient exchange dominates, which is exactly the regime gradient
 compression targets. Architecture per Simonyan & Zisserman (arXiv:1409.1556):
 stacked 3x3 convs between 2x2 max-pools, then a 3-layer classifier head.
 TPU-first notes: NHWC layout, optional BatchNorm after every conv (the
-"_bn" torchvision variants), and the torchvision head exactly — features are
-adaptively pooled to the canonical 7x7 grid (static-shape `jax.image.resize`,
-so any input resolution >= 32 jits) and flattened to the 25088-wide fc1,
-keeping vgg16 at its full ~138M parameters: the point of VGG in a gradient-
-compression benchmark is precisely that communication-bound head. Logits are
-computed in float32 (zoo convention, cf. resnet.py / transformer.py) even
-under a bf16 compute dtype.
+"_bn" torchvision variants), and the torchvision head *sizes* exactly —
+features are adaptively pooled to the canonical 7x7 grid (static-shape
+`jax.image.resize`, so any input resolution >= 32 jits) and flattened to the
+25088-wide fc1, keeping vgg16 at its full ~138M parameters: the point of VGG
+in a gradient-compression benchmark is precisely that communication-bound
+head. Not replicated from torchvision: classifier Dropout(0.5) and conv
+biases in the _bn variants (throughput/wire cost are parameter-shape
+properties; add dropout before using this for convergence studies). Logits
+are computed in float32 (zoo convention, cf. resnet.py / transformer.py)
+even under a bf16 compute dtype.
 """
 
 from __future__ import annotations

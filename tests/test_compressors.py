@@ -307,3 +307,47 @@ class TestTopKAlgorithms:
         g = grace_from_params({"compressor": "topk", "compress_ratio": 0.01,
                                "topk_algorithm": "chunk"})
         assert g.compressor.algorithm == "chunk"
+
+
+ALL_CODECS = ["none", "fp16", "bf16", "topk", "randomk", "threshold", "qsgd",
+              "terngrad", "signsgd", "signum", "efsignsgd", "onebit",
+              "natural", "dgc", "u8bit", "sketch", "adaq", "inceptionn"]
+
+
+@pytest.mark.parametrize("name", ALL_CODECS)
+def test_payload_shapes_are_value_independent(name, rng):
+    """The XLA contract: payload shapes depend only on the input SHAPE, never
+    on values (data-dependent sizes cannot compile; SURVEY.md §7 hard part 1).
+    Two very different value distributions must produce identical payload
+    shapes/dtypes and identical static ctx."""
+    from grace_tpu.helper import grace_from_params
+    c = grace_from_params({"compressor": name}).compressor
+    a = jnp.asarray(rng.normal(size=60).astype(np.float32))
+    b = jnp.asarray((rng.normal(size=60) * 1e6).astype(np.float32))
+    key = jax.random.key(0)
+    pa, ctxa, _ = c.compress(a, c.init_state(a), key)
+    pb, ctxb, _ = c.compress(b, c.init_state(b), key)
+    assert [(p.shape, p.dtype) for p in pa] == \
+           [(p.shape, p.dtype) for p in pb]
+    # static (non-array) ctx leaves must not depend on values either —
+    # a data-derived static aux value would break jit caching
+    def static_leaves(ctx):
+        return [l for l in jax.tree_util.tree_leaves(ctx)
+                if not isinstance(l, jax.Array)]
+    assert static_leaves(ctxa) == static_leaves(ctxb)
+
+
+@pytest.mark.parametrize("name", ALL_CODECS)
+@pytest.mark.parametrize("case", ["zeros", "tiny", "single", "constant"])
+def test_degenerate_inputs_stay_finite(name, case, rng):
+    """Zero gradients (frozen params, step 0 biases), denormals, single
+    elements and constants hit every divide-by-norm/scale path; decompress
+    must stay finite with the right shape/dtype."""
+    from grace_tpu.helper import grace_from_params
+    c = grace_from_params({"compressor": name}).compressor
+    x = {"zeros": jnp.zeros(48), "tiny": jnp.full(48, 1e-30),
+         "single": jnp.zeros(1), "constant": jnp.full(48, 3.25)}[case]
+    p, ctx, _ = c.compress(x, c.init_state(x), jax.random.key(1))
+    d = c.decompress(p, ctx)
+    assert d.shape == x.shape and d.dtype == x.dtype
+    assert bool(jnp.all(jnp.isfinite(d)))
