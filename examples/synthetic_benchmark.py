@@ -45,14 +45,10 @@ def build(args, mesh):
             # torchvision naming: vgg16 is plain, vgg16_bn has BatchNorm
             kwargs["batch_norm"] = spec.endswith("_bn")
             spec = spec.removesuffix("_bn")
-        if not spec.isdigit():
+        if not spec.isdigit() or int(spec) not in net.SUPPORTED_DEPTHS:
             raise SystemExit(f"unknown --model {args.model}")
-        try:
-            params, mstate = net.init(jax.random.key(args.seed),
-                                      depth=int(spec),
-                                      num_classes=args.num_classes, **kwargs)
-        except (ValueError, KeyError):   # unsupported depth (vgg15, resnet18)
-            raise SystemExit(f"unknown --model {args.model}")
+        params, mstate = net.init(jax.random.key(args.seed), depth=int(spec),
+                                  num_classes=args.num_classes, **kwargs)
 
         def loss_fn(params, mstate, batch):
             x, y = batch

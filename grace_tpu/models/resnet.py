@@ -20,6 +20,7 @@ from grace_tpu.models import layers as L
 
 # depth -> (block counts)
 _STAGES = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+SUPPORTED_DEPTHS = tuple(sorted(_STAGES))
 
 
 def _bottleneck_init(key, cin, cmid, stride):
@@ -58,6 +59,8 @@ def _bottleneck_apply(p, s, x, stride, train):
 
 def init(key: jax.Array, depth: int = 50, num_classes: int = 1000
          ) -> Tuple[L.Params, L.ModelState]:
+    if depth not in _STAGES:
+        raise ValueError(f"resnet depth must be one of {SUPPORTED_DEPTHS}")
     blocks = _STAGES[depth]
     keys = L.split_keys(key, 2 + sum(blocks))
     params, state = {}, {}

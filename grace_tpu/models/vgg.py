@@ -39,6 +39,7 @@ _PLANS = {
     19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
 }
+SUPPORTED_DEPTHS = tuple(sorted(_PLANS))
 
 
 def init(key: jax.Array, depth: int = 16, num_classes: int = 1000,

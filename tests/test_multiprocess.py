@@ -1,0 +1,157 @@
+"""True multi-process distributed tests: 2 processes, gloo, real DCN path.
+
+The reference exercises multi-node behavior only on live NCCL/MPI clusters
+(SURVEY.md §4: no fakes, no CI). The single-process suite simulates ranks as
+mesh devices; THIS file covers what that cannot: `jax.distributed`
+bring-up through `grace_tpu.parallel.initialize_distributed`, cross-process
+collectives, and the multi-process branches of `broadcast_tree` /
+`metric_average` (test_parallel.py covers only their single-process
+identity paths).
+
+Each test launches two subprocess workers that rendezvous on a fresh local
+port. Workers run the FULL compressed pipeline over a 4-device mesh (2
+devices per process), so the grace exchange genuinely crosses a process
+boundary. Workers print machine-checkable lines; the parent asserts both
+processes agree and match the expected values.
+"""
+
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_WORKER = r'''
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)   # 2 local -> 4 global devices
+
+port, pid = sys.argv[1], int(sys.argv[2])
+from grace_tpu.parallel import (broadcast_tree, data_parallel_mesh,
+                                initialize_distributed, metric_average)
+initialize_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from grace_tpu import grace_from_params
+from grace_tpu.train import init_train_state, make_train_step
+
+mesh = data_parallel_mesh()            # 4 global devices
+W = mesh.devices.size
+assert W == 4, W
+
+# Deterministic problem, identical on both hosts by construction.
+rng = np.random.default_rng(0)
+Wt = rng.standard_normal((12, 4))
+x = rng.standard_normal((64, 12)).astype(np.float32)
+y = np.argmax(x @ Wt, axis=1).astype(np.int32)
+
+def loss_fn(params, batch):
+    xb, yb = batch
+    logits = xb @ params["w"] + params["b"]
+    return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+grc = grace_from_params({"compressor": sys.argv[3],
+                         "memory": sys.argv[4],
+                         "communicator": sys.argv[5],
+                         "compress_ratio": 0.5})
+tx = optax.chain(grc.transform(seed=0), optax.sgd(0.05))
+params = {"w": jnp.zeros((12, 4)), "b": jnp.zeros((4,))}
+state = init_train_state(params, tx, mesh)
+step = make_train_step(loss_fn, tx, mesh, donate=False)
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+sharding = NamedSharding(mesh, P("data"))
+batch = (jax.make_array_from_process_local_data(
+             sharding, x[pid * 32:(pid + 1) * 32], (64, 12)),
+         jax.make_array_from_process_local_data(
+             NamedSharding(mesh, P("data")), y[pid * 32:(pid + 1) * 32],
+             (64,)))
+
+losses = []
+for _ in range(10):
+    state, loss = step(state, batch)
+    losses.append(float(jax.device_get(loss)))
+print(f"LOSSES {pid} {losses[0]:.6f} {losses[-1]:.6f}", flush=True)
+
+# Final params digest must be identical across processes (replicated).
+digest = float(sum(np.abs(np.asarray(jax.device_get(l))).sum()
+                   for l in jax.tree_util.tree_leaves(state.params)))
+print(f"DIGEST {pid} {digest:.8f}", flush=True)
+
+# broadcast_tree: root's value wins on every process.
+tree = {"v": np.full(3, float(pid))}
+out = broadcast_tree(tree, root_process=0)
+print(f"BCAST {pid} {out['v'].tolist()}", flush=True)
+
+# metric_average: mean over the two processes' host-side values.
+avg = metric_average({"acc": float(pid)})   # 0.0 and 1.0 -> 0.5
+print(f"AVG {pid} {float(avg['acc']):.4f}", flush=True)
+'''
+
+
+def _run_pair(compressor, memory, communicator, timeout=420):
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, str(port), str(i),
+         compressor, memory, communicator],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        # A hung or failed worker (collective deadlock — the failure mode
+        # this suite exists to catch) must not outlive the test and starve
+        # the rest of the session.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-2500:]}"
+    return outs
+
+
+def _field(out, tag):
+    for line in out.splitlines():
+        if line.startswith(tag + " "):
+            return line.split(" ", 2)[2]
+    raise AssertionError(f"{tag} line missing in:\n{out[-2000:]}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("cfg", [
+    ("topk", "residual", "allgather"),
+    ("signsgd", "none", "allreduce"),
+    ("topk", "residual", "twoshot"),
+], ids=lambda c: "-".join(c))
+def test_two_process_training_agrees_and_learns(cfg):
+    outs = _run_pair(*cfg)
+    first0, last0 = map(float, _field(outs[0], "LOSSES").split())
+    first1, last1 = map(float, _field(outs[1], "LOSSES").split())
+    # replicated loss: both processes observe the same values
+    assert abs(first0 - first1) < 1e-5 and abs(last0 - last1) < 1e-5
+    assert last0 < first0, (first0, last0)      # it actually learns
+    assert _field(outs[0], "DIGEST") == _field(outs[1], "DIGEST")
+
+
+@pytest.mark.slow
+def test_multiprocess_broadcast_and_metric_average():
+    outs = _run_pair("none", "none", "allreduce")
+    # root (process 0) value [0,0,0] wins on both processes
+    assert _field(outs[0], "BCAST") == _field(outs[1], "BCAST") \
+        == "[0.0, 0.0, 0.0]"
+    assert _field(outs[0], "AVG") == _field(outs[1], "AVG") == "0.5000"
