@@ -77,7 +77,8 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
                     optimizer: optax.GradientTransformation,
                     mesh: Mesh,
                     axis_name: str = DEFAULT_AXIS,
-                    donate: bool = True):
+                    donate: bool = True,
+                    remat: bool = False):
     """Build ``step(state, batch) -> (state, loss)``.
 
     ``loss_fn(params, batch)`` must return the mean loss over its *local*
@@ -87,7 +88,14 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
 
     ``batch`` is a pytree whose leaves are sharded on their leading dim over
     ``axis_name`` (the DistributedSampler analog, SURVEY.md §2.5).
+
+    ``remat=True`` wraps the loss in ``jax.checkpoint``: activations are
+    recomputed during backward instead of held in HBM — the standard
+    FLOPs-for-memory trade when activation footprint (not the gradient
+    exchange this library compresses) is the limiting factor.
     """
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
 
     def device_step(state: TrainState, batch):
         opt_state = strip_world_axis(state.opt_state)
@@ -106,7 +114,8 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
                              mesh: Mesh,
                              axis_name: str = DEFAULT_AXIS,
                              donate: bool = True,
-                             sync_model_state: bool = True):
+                             sync_model_state: bool = True,
+                             remat: bool = False):
     """Like :func:`make_train_step` for models with non-param state (BN stats).
 
     ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
@@ -114,7 +123,10 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
     statistics stay replicated (the reference's DDP examples leave BN stats
     rank-local and implicitly use rank 0's at save time; replication is the
     deterministic version of the same thing, and the stats are tiny).
+    ``remat`` as in :func:`make_train_step`.
     """
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
 
     def device_step(state: StatefulTrainState, batch):
         opt_state = strip_world_axis(state.opt_state)

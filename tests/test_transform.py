@@ -161,3 +161,65 @@ def test_old_style_state_rejected(mesh):
     # divisibility check does — both are loud ValueErrors, never silence.
     with pytest.raises(ValueError, match="world axis|evenly divisible"):
         step(bad, (x, y))
+
+
+def test_remat_step_matches_plain(mesh):
+    """jax.checkpoint changes memory scheduling, not math: remat and plain
+    steps must agree (to float tolerance — XLA may reassociate the
+    recomputed forward, so bitwise equality is not guaranteed)."""
+    rng = np.random.default_rng(3)
+    x, y = make_problem(rng)
+    cfg = {"compressor": "topk", "compress_ratio": 0.3,
+           "memory": "residual", "communicator": "allgather"}
+
+    def run(remat):
+        grc = grace_from_params(dict(cfg))
+        tx = optax.chain(grc.transform(seed=1), optax.sgd(0.1))
+        params = init_params(np.random.default_rng(3))
+        state = init_train_state(params, tx, mesh)
+        step = make_train_step(loss_fn, tx, mesh, donate=False, remat=remat)
+        for _ in range(5):
+            state, loss = step(state, (x, y))
+        return float(loss), state.params
+
+    loss_a, params_a = run(remat=False)
+    loss_b, params_b = run(remat=True)
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(params_a),
+                    jax.tree_util.tree_leaves(params_b)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-7)
+
+
+def test_remat_stateful_step_matches_plain(mesh):
+    """The stateful path composes jax.checkpoint with has_aux (BN-style
+    model state flows out of the rematted function) — cover it too."""
+    from grace_tpu.train import (init_stateful_train_state,
+                                 make_stateful_train_step)
+    rng = np.random.default_rng(5)
+    x, y = make_problem(rng)
+
+    def sloss(params, mstate, batch):
+        xb, yb = batch
+        logits = xb @ params["w"] + params["b"]
+        new_mstate = {"ema": 0.9 * mstate["ema"] + 0.1 * xb.mean()}
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        return loss.mean(), new_mstate
+
+    def run(remat):
+        grc = grace_from_params({"compressor": "topk", "compress_ratio": 0.3,
+                                 "memory": "residual",
+                                 "communicator": "allgather"})
+        tx = optax.chain(grc.transform(seed=1), optax.sgd(0.1))
+        params = init_params(np.random.default_rng(5))
+        mstate = {"ema": jnp.zeros(())}
+        state = init_stateful_train_state(params, mstate, tx, mesh)
+        step = make_stateful_train_step(sloss, tx, mesh, donate=False,
+                                        remat=remat)
+        for _ in range(3):
+            state, loss = step(state, (x, y))
+        return float(loss), float(state.model_state["ema"])
+
+    (loss_a, ema_a), (loss_b, ema_b) = run(False), run(True)
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-6)
+    np.testing.assert_allclose(ema_a, ema_b, rtol=1e-6)
