@@ -62,18 +62,23 @@ def setup_platform(platform: str):
     """Pin jax to the requested platform BEFORE any backend init."""
     import jax
 
-    # Persistent compilation cache: the two ResNet-50 train-step compiles
-    # dominate worker wall-clock on the tunnel (minutes each) and put the
-    # run uncomfortably close to WORKER_TIMEOUT_S. Any earlier bench run on
-    # this host (same jax/backend version) makes later ones compile-free.
-    try:
-        import tempfile
-        cache_dir = os.path.join(tempfile.gettempdir(),
-                                 f"grace_tpu_jax_cache_{os.getuid()}")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-    except Exception as e:  # cache is an optimization, never a requirement
-        print(f"[bench] compilation cache unavailable: {e}",
-              file=sys.stderr, flush=True)
+    # Persistent compilation cache — TPU only: the two ResNet-50 train-step
+    # compiles dominate worker wall-clock on the tunnel (minutes each) and
+    # put the run uncomfortably close to WORKER_TIMEOUT_S; any earlier bench
+    # run on this host makes later ones compile-free. NOT enabled for the
+    # CPU fallback: XLA:CPU caches AOT machine code keyed loosely enough
+    # that an entry compiled under different detected CPU features loads
+    # with a "could lead to SIGILL" warning — a crash there would cost the
+    # fallback number entirely, for a compile that is cheap anyway.
+    if platform == "tpu":
+        try:
+            import tempfile
+            cache_dir = os.path.join(tempfile.gettempdir(),
+                                     f"grace_tpu_jax_cache_{os.getuid()}")
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except Exception as e:  # cache is an optimization, not a requirement
+            print(f"[bench] compilation cache unavailable: {e}",
+                  file=sys.stderr, flush=True)
 
     if platform == "cpu":
         # Same dance as tests/conftest.py: the image's sitecustomize latches
