@@ -1,0 +1,116 @@
+"""PyTorch synthetic throughput benchmark through the TPU interop path.
+
+Workflow parity with the reference's flagship benchmark
+(examples/torch/pytorch_synthetic_benchmark.py: torchvision model, fixed
+random batch, img/sec mean ±1.96σ over timed iterations), driven through
+``DistributedOptimizer`` so the compressed exchange runs as one jitted XLA
+program. torchvision is not a dependency here, so the model is a first-party
+torch ResNet-ish CNN whose parameter count is dominated by a wide classifier
+— communication-bound like the reference's default, at a CPU-torch-friendly
+scale (the reference assumes a GPU for the backward pass; this image's torch
+is CPU-only, SURVEY.md §2.9).
+
+Run (simulated 8-device mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/torch_synthetic_benchmark.py \\
+        --compressor signum --memory residual   # the reference's active grc
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import common  # noqa: E402 — sys.path bootstrap so grace_tpu imports resolve
+from grace_tpu import grace_from_params
+from grace_tpu.interop.torch import DistributedOptimizer, broadcast_parameters
+from grace_tpu.parallel import data_parallel_mesh, initialize_distributed
+from grace_tpu.utils import rank_zero_print
+
+
+class BenchNet(torch.nn.Module):
+    """Small conv trunk + wide head: most parameters sit in the exchange."""
+
+    def __init__(self, width: int = 512, num_classes: int = 1000):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(3, 32, 3, stride=2, padding=1)
+        self.conv2 = torch.nn.Conv2d(32, 64, 3, stride=2, padding=1)
+        self.fc1 = torch.nn.Linear(64, width)
+        self.fc2 = torch.nn.Linear(width, width)
+        self.fc3 = torch.nn.Linear(width, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        x = x.mean(dim=(2, 3))
+        x = F.relu(self.fc1(x))
+        x = F.relu(self.fc2(x))
+        return self.fc3(x)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    common.add_grace_args(parser)
+    parser.set_defaults(compressor="signum", memory="residual")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+
+    initialize_distributed()
+    mesh = data_parallel_mesh()
+    torch.manual_seed(args.seed)
+
+    model = BenchNet(num_classes=args.num_classes)
+    n_params = sum(p.numel() for p in model.parameters())
+    rank_zero_print(f"Model: BenchNet, {n_params / 1e6:.1f}M params, "
+                    f"batch {args.batch_size}/process")
+
+    grace = grace_from_params(common.grace_params_from_args(args))
+    broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = torch.optim.SGD(model.parameters(), lr=args.lr)
+    opt = DistributedOptimizer(opt, grace,
+                               named_parameters=model.named_parameters(),
+                               mesh=mesh, seed=args.seed)
+
+    rng = np.random.default_rng(args.seed)
+    data = torch.from_numpy(rng.standard_normal(
+        (args.batch_size, 3, args.image_size, args.image_size)
+    ).astype(np.float32))
+    target = torch.from_numpy(rng.integers(
+        0, args.num_classes, (args.batch_size,)).astype(np.int64))
+
+    def run_batch():
+        opt.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        opt.step()
+
+    for _ in range(args.num_warmup_batches):
+        run_batch()
+
+    per_iter = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            run_batch()
+        dt = time.perf_counter() - t0
+        ips = args.batch_size * args.num_batches_per_iter / dt
+        per_iter.append(ips)
+        rank_zero_print(f"Iter #{i}: {ips:.1f} img/sec per process")
+
+    mean = float(np.mean(per_iter))
+    rank_zero_print(f"Img/sec per process: {mean:.1f} "
+                    f"+-{1.96 * float(np.std(per_iter)):.1f}")
+
+
+if __name__ == "__main__":
+    main()
