@@ -174,28 +174,12 @@ def bench_configs(platform: str, configs, emit) -> None:
     batch = jax.device_put((x, y), batch_sharded(mesh))
 
     def wire_bytes(grace, params):
-        """Bytes-on-wire per step per rank. PowerSGD gets an analytic count
-        (its compress psums inside shard_map, out of wire_report's reach);
-        any other compressor that fails wire_report is a real bug — re-raise
-        rather than emit plausible-looking wrong numbers."""
-        from grace_tpu.compressors import PowerSGDCompressor
+        """Bytes-on-wire per step per rank. PowerSGD is covered by its
+        analytic Compressor.wire_nbytes (its compress psums inside
+        shard_map, out of shape-tracing's reach); a compressor that fails
+        here is a real bug — re-raise rather than emit plausible-looking
+        wrong numbers."""
         from grace_tpu.utils import wire_report
-        if isinstance(grace.compressor, PowerSGDCompressor):
-            # Metadata-only arithmetic: the training step donates its state,
-            # so the underlying buffers may already be deleted here.
-            leaves = jax.tree_util.tree_leaves(params)
-            dense = sum(l.size * 4 for l in leaves)
-            rank = grace.compressor.rank
-            wire = 0
-            for l in leaves:
-                if l.ndim < 2:
-                    wire += l.size * 4
-                else:
-                    # (-1, shape[-1]) matricization, see compressors/powersgd
-                    cols = l.shape[-1]
-                    rows = l.size // cols
-                    wire += (rows + cols) * min(rows, cols, rank) * 4
-            return dense, wire
         rep = wire_report(grace.compressor, params)
         return rep.dense_bytes, rep.wire_bytes
 

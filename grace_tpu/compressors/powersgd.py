@@ -40,6 +40,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from grace_tpu.core import DEFAULT_AXIS, Compressor, Ctx, Payload, State
@@ -55,25 +56,36 @@ class PowerSGDCompressor(Compressor):
     # payload that sums/averages consistently.
     summable_payload = True
 
-    def _factor_shapes(self, x: jax.Array):
-        m = x.shape[-1]            # output-channel dim (HWIO/(*, features))
-        n = x.size // m
+    def _factor_shapes(self, shape):
+        m = shape[-1]              # output-channel dim (HWIO/(*, features))
+        n = int(np.prod(shape[:-1], dtype=np.int64))
         r = min(n, m, self.rank)
         return n, m, r
 
     def init_state(self, x: jax.Array) -> State:
         if x.ndim <= 1:
             return None
-        _, m, r = self._factor_shapes(x)
+        _, m, r = self._factor_shapes(x.shape)
         # Deterministic initial Q; identical on all ranks by construction.
         return jax.random.normal(jax.random.key(x.size), (m, r), x.dtype)
+
+    def wire_nbytes(self, shape, dtype) -> int:
+        """Analytic: compress's psums of P (n,r) and Q (m,r) ARE the wire
+        traffic; the payload tuple is empty and compress cannot be
+        shape-traced without a bound mesh axis."""
+        itemsize = jnp.dtype(dtype).itemsize
+        if len(shape) <= 1:
+            # 1-D bypass rides dense
+            return int(np.prod(shape, dtype=np.int64)) * itemsize
+        n, m, r = self._factor_shapes(shape)
+        return (n + m) * r * itemsize
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
         if x.ndim <= 1:
             return (x,), None, state
         shape = x.shape
-        n, m, r = self._factor_shapes(x)
+        n, m, r = self._factor_shapes(shape)
         matrix = x.reshape(n, m)   # n = prod(leading dims), m = shape[-1]
         if self.warm_start:
             q = state

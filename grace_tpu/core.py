@@ -85,6 +85,14 @@ class Compressor:
         """Initial per-leaf state (e.g. Signum momentum, PowerSGD Q)."""
         return None
 
+    # -- metrics ------------------------------------------------------------
+    def wire_nbytes(self, shape, dtype) -> int | None:
+        """Analytic bytes-on-wire for one tensor, or None to let
+        :func:`grace_tpu.utils.payload_nbytes` shape-trace ``compress``.
+        Override when compress cannot be traced without a bound mesh axis
+        (PowerSGD's in-compress psum)."""
+        return None
+
     # -- codec --------------------------------------------------------------
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

@@ -34,10 +34,14 @@ def payload_nbytes(compressor: Compressor, x: jax.Array | jax.ShapeDtypeStruct
                    ) -> int:
     """Logical wire bytes of ``compressor``'s payload for one tensor ``x``.
 
-    Note: compressors whose ``compress`` itself performs collectives
-    (PowerSGD) must be measured inside a bound mesh context; for those,
-    account the P/Q factors directly instead.
+    Compressors whose ``compress`` itself performs collectives (PowerSGD)
+    cannot be shape-traced outside a bound mesh axis; they declare their
+    wire cost analytically via ``Compressor.wire_nbytes``, which takes
+    precedence here.
     """
+    declared = compressor.wire_nbytes(jnp.shape(x), jnp.result_type(x))
+    if declared is not None:
+        return declared
     x_spec = jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
 
     def encode(x):

@@ -93,6 +93,16 @@ class TestFusedTraining:
                                   "fusion": fusion}, steps=40)
         assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
 
+    def test_topk_twoshot_fused_converges(self, mesh):
+        """The bench's topk1pct_twoshot config: flat fusion hands the
+        two-shot communicator ONE whole-model buffer to chunk."""
+        losses, _ = _train(mesh, {"compressor": "topk",
+                                  "compress_ratio": 0.3,
+                                  "memory": "residual",
+                                  "communicator": "twoshot",
+                                  "fusion": "flat"}, steps=40)
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
     def test_qsgd_fused_converges(self, mesh):
         losses, _ = _train(mesh, {"compressor": "qsgd", "quantum_num": 64,
                                   "memory": "none",

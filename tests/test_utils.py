@@ -157,3 +157,19 @@ class TestWireMetrics:
         x = jnp.zeros((1000,), jnp.float32)
         b = payload_nbytes(C.RandomKCompressor(compress_ratio=0.01), x)
         assert b == 10 * 4
+
+
+def test_wire_report_powersgd_analytic():
+    """PowerSGD's compress psums inside shard_map, so wire_report must use
+    its analytic wire_nbytes instead of shape-tracing compress (regression:
+    the digits example once crashed with 'unbound axis name: data')."""
+    import jax.numpy as jnp
+
+    from grace_tpu.compressors import PowerSGDCompressor
+    from grace_tpu.utils import wire_report
+
+    params = {"w": jnp.zeros((20, 8)), "b": jnp.zeros((8,))}
+    rep = wire_report(PowerSGDCompressor(rank=4), params)
+    # w: (20+8)*4 floats; b rides dense: 8 floats
+    assert rep.wire_bytes == ((20 + 8) * 4 + 8) * 4
+    assert rep.dense_bytes == (20 * 8 + 8) * 4
