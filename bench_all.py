@@ -100,9 +100,20 @@ CONFIGS = [
 WORKER_TIMEOUT_S = 600 * len(CONFIGS)
 
 
+# Sweep-specific TPU evidence file (same incremental-persistence contract as
+# bench.py's BENCH_TPU_LAST.json): every measured row lands on disk
+# immediately, so a mid-sweep tunnel death keeps the completed prefix.
+SWEEP_EVIDENCE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL_TPU_LAST.json")
+
+
 def _worker(platform: str) -> None:
-    bench.bench_configs(platform, CONFIGS,
-                        lambda r: print(json.dumps(r), flush=True))
+    emit = bench.progressive_emit(
+        lambda r: print(json.dumps(r), flush=True),
+        n_expected=len(CONFIGS),
+        evidence_path=SWEEP_EVIDENCE_PATH,
+        metric="resnet50_all_configs_imgs_per_sec")
+    bench.bench_configs(platform, CONFIGS, emit)
 
 
 def main() -> None:

@@ -70,6 +70,9 @@ def _lazy_sharded_step(device_step, mesh: Mesh, axis_name: str, donate: bool):
             cache[key] = fn
         return fn(state, batch)
 
+    # Callers (bench.py MFU accounting) can reach the underlying jitted fns
+    # for AOT introspection (lower().cost_analysis()) without re-wrapping.
+    step.jit_cache = cache
     return step
 
 

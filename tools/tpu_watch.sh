@@ -1,0 +1,28 @@
+#!/bin/bash
+# TPU tunnel watcher (round-2 postmortem: the tunnel to the single real chip
+# goes down for hours at a stretch — backend init hangs rather than erroring).
+# Probe on a schedule; on first success run the headline dense-vs-compressed
+# pair, then the full per-algorithm sweep, directly in TPU worker mode.
+# Evidence lands incrementally in BENCH_TPU_LAST.json / BENCH_ALL_TPU_LAST.json
+# (written row-by-row by the workers), so even a mid-run tunnel death keeps
+# every measured config.
+#
+# Usage: setsid nohup tools/tpu_watch.sh &   (log: tpu_watch.log at repo root)
+cd "$(dirname "$0")/.." || exit 1
+LOG=tpu_watch.log
+while true; do
+  echo "=== $(date -u +%FT%TZ) probing" >> "$LOG"
+  if timeout 300 python -c \
+      "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" \
+      >> "$LOG" 2>&1; then
+    echo "=== $(date -u +%FT%TZ) tunnel ALIVE — headline bench" >> "$LOG"
+    timeout 1800 python bench.py --_worker tpu >> "$LOG" 2>&1
+    echo "=== headline rc=$?" >> "$LOG"
+    echo "=== $(date -u +%FT%TZ) per-algorithm sweep" >> "$LOG"
+    timeout 9000 python bench_all.py --_worker tpu >> "$LOG" 2>&1
+    echo "=== sweep rc=$? — watcher done" >> "$LOG"
+    break
+  fi
+  echo "=== $(date -u +%FT%TZ) tunnel dead, sleeping 600s" >> "$LOG"
+  sleep 600
+done
