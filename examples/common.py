@@ -145,6 +145,29 @@ def load_mnist_idx(data_dir: str, train: bool = True):
     return x, y
 
 
+BUNDLED_MNIST_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "data", "MNIST", "raw")
+
+
+def load_mnist_auto(data_dir: str, split_seed: int = 0):
+    """(x_train, y_train, x_test, y_test), normalized, from whatever MNIST
+    files ``data_dir`` holds: the full train/t10k pair when present, else a
+    deterministic 8,000/2,000 split of the t10k set alone (the bundled
+    fixture case — see grace_tpu.data.mnist_split_dataset)."""
+    has_full = any(
+        os.path.exists(os.path.join(data_dir, "train-images-idx3-ubyte" + s))
+        for s in ("", ".gz"))
+    if has_full:
+        return (*load_mnist_idx(data_dir, train=True),
+                *load_mnist_idx(data_dir, train=False))
+    from grace_tpu.data import mnist_split_dataset
+    tr = mnist_split_dataset(data_dir, train=True, split_seed=split_seed)
+    te = mnist_split_dataset(data_dir, train=False, split_seed=split_seed)
+    # Eval uses the train stats (the torchvision convention).
+    return (tr.normalize(tr.images), tr.labels,
+            tr.normalize(te.images), te.labels)
+
+
 def load_cifar10_binary(data_dir: str, train: bool = True):
     """Read CIFAR-10 binary batches (data_batch_*.bin / test_batch.bin)."""
     names = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \

@@ -1,0 +1,122 @@
+"""Real-MNIST convergence evidence: LeNet on the bundled 10k-image set.
+
+The flagship real-data curve (VERDICT round-2 item 3): the repo bundles the
+public-domain MNIST test set (10,000 real handwritten digits, the same
+fixture files the reference commits under examples/torch/data-0/MNIST/raw
+so its 2-rank examples run without downloads) at examples/data/MNIST/raw.
+`grace_tpu.data.mnist_split_dataset` makes a deterministic 8,000/2,000
+train/test split; training runs the full GRACE pipeline (compensate →
+compress → update → exchange) over the device mesh, so a healthy accuracy
+curve here is end-to-end evidence that compressed training converges on
+real MNIST — superseding the 8×8 UCI digits curve (digits_lenet.py) as the
+primary committed evidence.
+
+Run (simulated 8-device mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/mnist10k_lenet.py --compressor topk \\
+        --compress-ratio 0.01 --memory residual \\
+        --tsv logs/mnist10k_topk1pct.tsv
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import common  # noqa: E402 — sys.path bootstrap so grace_tpu imports resolve
+from grace_tpu import grace_from_params
+from grace_tpu.data import mnist_split_dataset
+from grace_tpu.models import lenet
+from grace_tpu.parallel import batch_sharded, data_parallel_mesh
+from grace_tpu.train import (init_stateful_train_state,
+                             make_stateful_train_step)
+from grace_tpu.utils import TableLogger, Timer, rank_zero_print, wire_report
+
+BUNDLED_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "MNIST", "raw")
+
+
+def run(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    common.add_grace_args(parser)
+    parser.add_argument("--epochs", type=int, default=40)
+    parser.add_argument("--batch-size", type=int, default=256,
+                        help="global batch (split across the mesh)")
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--data-dir", default=BUNDLED_DIR,
+                        help="directory with the MNIST t10k idx(.gz) files")
+    parser.add_argument("--tsv", default=None,
+                        help="write per-epoch log (epoch\\tloss\\tacc) here")
+    args = parser.parse_args(argv)
+
+    mesh = data_parallel_mesh()
+    train = mnist_split_dataset(args.data_dir, train=True)
+    test = mnist_split_dataset(args.data_dir, train=False)
+    x_train = train.normalize(train.images)
+    y_train = train.labels
+    # Eval uses the train stats (the torchvision convention).
+    x_test = train.normalize(test.images)
+    y_test = test.labels
+    rank_zero_print(f"real MNIST: {len(x_train)} train / {len(x_test)} test")
+
+    grace = grace_from_params(common.grace_params_from_args(args))
+    optimizer = optax.chain(grace.transform(seed=args.seed),
+                            optax.sgd(args.lr, momentum=0.9))
+    params, mstate = lenet.init(jax.random.key(args.seed))
+    rank_zero_print("wire cost:", wire_report(grace.compressor, params))
+
+    def loss_fn(params, mstate, batch):
+        xb, yb = batch
+        logits, new_mstate = lenet.apply(params, mstate, xb)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        return loss.mean(), new_mstate
+
+    step = make_stateful_train_step(loss_fn, optimizer, mesh)
+    ts = init_stateful_train_state(params, mstate, optimizer, mesh)
+
+    # The 2,000-image test split evaluates in one replicated jit call on
+    # device 0 — exactness matters more than speed here.
+    eval_fn = jax.jit(lambda p, s, x: lenet.apply(p, s, x, train=False))
+
+    def accuracy(params, mstate):
+        logits, _ = eval_fn(params, mstate, jnp.asarray(x_test))
+        return float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(y_test)))
+
+    log = TableLogger()
+    timer = Timer()
+    rows = ["epoch\ttrain_loss\ttest_acc"]
+    test_acc = 0.0
+    for epoch in range(1, args.epochs + 1):
+        losses = []
+        for xb, yb in common.batches(x_train, y_train, args.batch_size,
+                                     shuffle=True, seed=args.seed + epoch):
+            batch = jax.device_put((jnp.asarray(xb), jnp.asarray(yb)),
+                                   batch_sharded(mesh))
+            ts, loss = step(ts, batch)
+            # Per-step host sync: this epoch enqueues ~60 steps, and on a
+            # host with fewer cores than mesh devices an unbounded queue of
+            # multi-device programs can starve the collective rendezvous
+            # (all device threads futex-parked). On a real TPU mesh drop
+            # this and let XLA pipeline.
+            losses.append(float(loss))
+        train_loss = sum(losses) / len(losses)
+        test_acc = accuracy(ts.params, ts.model_state)
+        log.append({"epoch": epoch, "train loss": train_loss,
+                    "epoch time": timer(), "test acc": test_acc})
+        rows.append(f"{epoch}\t{train_loss:.4f}\t{test_acc:.4f}")
+
+    if args.tsv:
+        os.makedirs(os.path.dirname(args.tsv) or ".", exist_ok=True)
+        with open(args.tsv, "w") as f:
+            f.write("\n".join(rows) + "\n")
+        rank_zero_print(f"log -> {args.tsv}")
+    return test_acc
+
+
+if __name__ == "__main__":
+    acc = run()
+    rank_zero_print(f"final test accuracy: {acc:.4f}")

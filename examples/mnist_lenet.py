@@ -41,10 +41,13 @@ def main():
                         help="global batch (split across the mesh)")
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--train-size", type=int, default=16384,
-                        help="synthetic dataset size")
-    parser.add_argument("--data-dir", default=None,
-                        help="directory with MNIST idx files (default: "
-                             "synthetic data)")
+                        help="synthetic dataset size (with --synthetic)")
+    parser.add_argument("--data-dir", default=common.BUNDLED_MNIST_DIR,
+                        help="directory with MNIST idx files (default: the "
+                             "bundled 10k-image fixture set, split 80/20)")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="train on synthetic digits instead of the "
+                             "bundled real MNIST images")
     parser.add_argument("--ckpt-dir", default=None,
                         help="save a checkpoint here after training")
     args = parser.parse_args()
@@ -56,12 +59,14 @@ def main():
         raise SystemExit(f"--batch-size {args.batch_size} must divide by "
                          f"the {world}-device mesh")
 
-    if args.data_dir:
-        x_train, y_train = common.load_mnist_idx(args.data_dir, train=True)
-        x_test, y_test = common.load_mnist_idx(args.data_dir, train=False)
-    else:
+    if args.synthetic or not args.data_dir:
         x_train, y_train = common.synthetic_mnist(args.train_size, args.seed)
         x_test, y_test = common.synthetic_mnist(4096, args.seed + 1)
+    else:
+        x_train, y_train, x_test, y_test = common.load_mnist_auto(
+            args.data_dir)
+        rank_zero_print(f"real MNIST from {args.data_dir}: "
+                        f"{len(x_train)} train / {len(x_test)} test")
 
     if len(x_train) < args.batch_size or len(x_test) < args.batch_size:
         raise SystemExit(f"--batch-size {args.batch_size} exceeds dataset "

@@ -180,6 +180,39 @@ def _join_ctx(treedef, static, arrays):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def ctx_is_data_free(compressor: Compressor, n: int, dtype) -> bool:
+    """True iff no ctx array leaf of ``compressor.compress`` depends on the
+    *data* (rng-derived and constant leaves are fine).
+
+    TwoShotAllreduce decodes every rank's gathered stage-2 chunk with the
+    rank-local ctx2 from compressing this rank's own (rank-divergent)
+    aggregate. That is only sound when ctx array leaves are functions of
+    shape and the shared rng alone — a codec that stashes e.g. its input's
+    norm in ctx would silently corrupt every other rank's chunk. Checked
+    structurally: trace ``compress`` to a jaxpr and taint-walk from the data
+    input; conservative for opaque sub-calls (pjit/scan/cond propagate taint
+    through all outputs), so a false *positive* is possible but a silent
+    false negative is not.
+    """
+    def ctx_arrays(x, key):
+        _, ctx, _ = compressor.compress(x, None, key)
+        _, _, arrays = _split_ctx(ctx)
+        return tuple(arrays)
+
+    from jax.extend.core import Var
+
+    closed = jax.make_jaxpr(ctx_arrays)(
+        jax.ShapeDtypeStruct((n,), dtype),
+        jax.eval_shape(lambda: jax.random.key(0)))
+    jaxpr = closed.jaxpr
+    tainted = {jaxpr.invars[0]}
+    for eqn in jaxpr.eqns:
+        if any(isinstance(v, Var) and v in tainted for v in eqn.invars):
+            tainted.update(eqn.outvars)
+    return not any(isinstance(v, Var) and v in tainted
+                   for v in jaxpr.outvars)
+
+
 @dataclasses.dataclass(frozen=True)
 class _ChunkedView:
     """Decompress-only adapter: (w, …) stacked chunk payloads → full leaf.
@@ -281,6 +314,16 @@ class TwoShotAllreduce(Communicator):
                 f"TwoShotAllreduce needs a wire payload to scatter; "
                 f"{type(compressor).__name__} communicates inside compress "
                 "— use Allreduce instead.")
+        if not ctx_is_data_free(compressor, chunks.shape[1], chunks.dtype):
+            raise TypeError(
+                f"TwoShotAllreduce requires a data-free ctx; "
+                f"{type(compressor).__name__}.compress puts data-derived "
+                "arrays in ctx, and stage 3 decodes every rank's gathered "
+                "chunk with the rank-local ctx2 (built from this rank's own "
+                "divergent aggregate) — other ranks' chunks would decode "
+                "against the wrong values. Keep data-derived arrays in the "
+                "payload (they travel on the wire) or use "
+                "Allgather/Allreduce.")
         treedef, static, _ = _split_ctx(probe_ctx)
 
         def comp_one(chunk, c):

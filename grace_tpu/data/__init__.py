@@ -31,8 +31,8 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 __all__ = ["MemoryDataset", "NativeLoader", "PythonLoader", "make_loader",
-           "native_library_path", "mnist_dataset", "cifar10_dataset",
-           "digits_dataset"]
+           "native_library_path", "mnist_dataset", "mnist_split_dataset",
+           "cifar10_dataset", "digits_dataset"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -101,6 +101,26 @@ def mnist_dataset(data_dir: str, train: bool = True) -> MemoryDataset:
     """MNIST idx(.gz) files -> MemoryDataset with the standard stats."""
     x, y = _read_idx(data_dir, train)
     return MemoryDataset(x, y, mean=(0.1307,), std=(0.3081,))
+
+
+def mnist_split_dataset(data_dir: str, train: bool = True,
+                        split_seed: int = 0,
+                        fraction: float = 0.8) -> MemoryDataset:
+    """Fixed-seed 80/20 split of the MNIST *t10k* file set.
+
+    The reference ships the 10,000-image MNIST test set as committed example
+    fixtures (examples/torch/data-{0,1}/MNIST/raw/t10k-*) so 2-rank runs
+    need no downloads; this repo bundles the same public-domain files under
+    examples/data/MNIST/raw. With only the t10k files available, real-data
+    training evidence comes from a deterministic shuffle-then-split: 8,000
+    train / 2,000 held-out test, disjoint by construction, reproducible for
+    a given ``split_seed``.
+    """
+    x, y = _read_idx(data_dir, train=False)
+    idx = np.random.default_rng(split_seed).permutation(len(x))
+    cut = int(fraction * len(x))
+    sel = np.sort(idx[:cut] if train else idx[cut:])
+    return MemoryDataset(x[sel], y[sel], mean=(0.1307,), std=(0.3081,))
 
 
 def digits_dataset(train: bool = True, upscale: bool = True,

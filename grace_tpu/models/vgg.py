@@ -8,16 +8,17 @@ gradient exchange dominates, which is exactly the regime gradient
 compression targets. Architecture per Simonyan & Zisserman (arXiv:1409.1556):
 stacked 3x3 convs between 2x2 max-pools, then a 3-layer classifier head.
 TPU-first notes: NHWC layout, optional BatchNorm after every conv (the
-"_bn" torchvision variants), and the torchvision head *sizes* exactly —
-features are adaptively pooled to the canonical 7x7 grid (static-shape
-`jax.image.resize`, so any input resolution >= 32 jits) and flattened to the
-25088-wide fc1, keeping vgg16 at its full ~138M parameters: the point of VGG
-in a gradient-compression benchmark is precisely that communication-bound
-head. Not replicated from torchvision: classifier Dropout(0.5) and conv
-biases in the _bn variants (throughput/wire cost are parameter-shape
-properties; add dropout before using this for convergence studies). Logits
-are computed in float32 (zoo convention, cf. resnet.py / transformer.py)
-even under a bf16 compute dtype.
+"_bn" torchvision variants), and the torchvision head exactly — features
+are pooled to the canonical 7x7 grid with true AdaptiveAvgPool2d semantics
+(static-slice means, any input resolution >= 32 jits; see
+`_adaptive_avg_pool`) and flattened to the 25088-wide fc1, keeping vgg16 at
+its full ~138M parameters: the point of VGG in a gradient-compression
+benchmark is precisely that communication-bound head. Not replicated from
+torchvision: classifier Dropout(0.5) and conv biases in the _bn variants
+(throughput/wire cost are parameter-shape properties; add dropout before
+using this for convergence studies). Logits are computed in float32 (zoo
+convention, cf. resnet.py / transformer.py) even under a bf16 compute
+dtype.
 """
 
 from __future__ import annotations
@@ -69,6 +70,30 @@ def init(key: jax.Array, depth: int = 16, num_classes: int = 1000,
     return params, state
 
 
+def _adaptive_avg_pool(x: jax.Array, out: int) -> jax.Array:
+    """Exact torchvision ``AdaptiveAvgPool2d((out, out))`` semantics.
+
+    Output cell (i, j) averages input rows [⌊i·h/out⌋, ⌈(i+1)·h/out⌉) ×
+    the analogous columns — a true pool for grids larger than ``out`` and
+    cell duplication for smaller ones (e.g. the 1×1 grid of a 32px input
+    broadcasts, it is not bilinearly upsampled). All bounds are static
+    under jit (h, w are trace-time constants), so this lowers to ``out²``
+    static-slice means XLA fuses freely — no dynamic shapes.
+    """
+    n, h, w, c = x.shape
+
+    def bounds(size):
+        return [(i * size // out, -((-(i + 1) * size) // out))
+                for i in range(out)]
+
+    rows_out = []
+    for r0, r1 in bounds(h):
+        cols_out = [x[:, r0:r1, c0:c1].mean(axis=(1, 2))
+                    for c0, c1 in bounds(w)]
+        rows_out.append(jnp.stack(cols_out, axis=1))   # (n, out, c)
+    return jnp.stack(rows_out, axis=1)                 # (n, out, out, c)
+
+
 def apply(params: L.Params, state: L.ModelState, x: jax.Array, *,
           train: bool = True, depth: int | None = None
           ) -> Tuple[jax.Array, L.ModelState]:
@@ -91,10 +116,7 @@ def apply(params: L.Params, state: L.ModelState, x: jax.Array, *,
             x, new_state[bn] = L.bn_apply(params[bn], state[bn], x, train)
         x = jax.nn.relu(x)
     if x.shape[1] != 7 or x.shape[2] != 7:
-        # Adaptive pool to the canonical 7x7 grid (torchvision
-        # AdaptiveAvgPool2d((7, 7))): static shapes, any input size.
-        x = jax.image.resize(x, (x.shape[0], 7, 7, x.shape[3]),
-                             method="linear")
+        x = _adaptive_avg_pool(x, 7)
     x = x.reshape(x.shape[0], -1)                 # (N, 25088)
     x = jax.nn.relu(L.dense_apply(params["fc1"], x))
     x = jax.nn.relu(L.dense_apply(params["fc2"], x))

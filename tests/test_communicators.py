@@ -268,6 +268,45 @@ class TestTwoShotAllreduce:
                                "communicator": "twoshot"})
         assert isinstance(g.communicator, comm.TwoShotAllreduce)
 
+    def test_rejects_data_derived_ctx(self, mesh, rng):
+        """Stage 3 decodes every rank's gathered chunk with the rank-local
+        ctx2, which is only sound for data-free ctx arrays. A codec that
+        stashes e.g. its input's norm in ctx (legal under the base Ctx
+        contract) must be rejected at trace time, not silently corrupt."""
+        import pytest
+        from grace_tpu.memories import NoneMemory
+
+        class NormInCtx(C.NoneCompressor):
+            def compress(self, x, state, rng):
+                norm = jnp.maximum(jnp.linalg.norm(x), 1e-12)
+                return (x / norm,), {"norm": norm}, state
+
+            def decompress(self, payload, ctx):
+                return payload[0] * ctx["norm"]
+
+        x = rng.normal(size=(W, 32)).astype(np.float32)
+        with pytest.raises(TypeError, match="data-free ctx"):
+            run_step(mesh, comm.TwoShotAllreduce(), NormInCtx(),
+                     NoneMemory(), jnp.asarray(x))
+
+    def test_catalog_stateless_codecs_have_data_free_ctx(self):
+        """Every stateless catalog codec must keep data-derived arrays in
+        the payload (the TwoShot soundness condition, checked structurally
+        by comm.ctx_is_data_free)."""
+        codecs = [C.NoneCompressor(), C.FP16Compressor(),
+                  C.TopKCompressor(compress_ratio=0.1),
+                  C.RandomKCompressor(compress_ratio=0.1),
+                  C.ThresholdCompressor(threshold=0.01),
+                  C.QSGDCompressor(quantum_num=64), C.TernGradCompressor(),
+                  C.SignSGDCompressor(), C.EFSignSGDCompressor(lr=0.1),
+                  C.OneBitCompressor(), C.NaturalCompressor(),
+                  C.DgcCompressor(compress_ratio=0.1), C.U8bitCompressor(),
+                  C.SketchCompressor(bins=64),
+                  C.AdaqCompressor(compress_ratio=0.1),
+                  C.InceptionNCompressor()]
+        for codec in codecs:
+            assert comm.ctx_is_data_free(codec, 256, jnp.float32), codec
+
     def test_stage2_feedback_tightens_tracking(self, mesh, rng):
         """ScaleCom-style owner error feedback: with stage2_feedback the
         cumulative aggregated gradient tracks the allgather (single-loss)

@@ -17,11 +17,21 @@ while true; do
       >> "$LOG" 2>&1; then
     echo "=== $(date -u +%FT%TZ) tunnel ALIVE — headline bench" >> "$LOG"
     timeout 1800 python bench.py --_worker tpu >> "$LOG" 2>&1
-    echo "=== headline rc=$?" >> "$LOG"
+    rc1=$?
+    echo "=== headline rc=$rc1" >> "$LOG"
     echo "=== $(date -u +%FT%TZ) per-algorithm sweep" >> "$LOG"
     timeout 9000 python bench_all.py --_worker tpu >> "$LOG" 2>&1
-    echo "=== sweep rc=$? — watcher done" >> "$LOG"
-    break
+    rc2=$?
+    echo "=== sweep rc=$rc2" >> "$LOG"
+    # Only retire the watcher once BOTH measurements actually landed —
+    # a tunnel that dies mid-bench must put us back into the probe loop
+    # (partial rows are already persisted by the workers either way).
+    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ]; then
+      echo "=== $(date -u +%FT%TZ) both benches complete — watcher done" \
+        >> "$LOG"
+      break
+    fi
+    echo "=== $(date -u +%FT%TZ) bench(es) failed, back to probing" >> "$LOG"
   fi
   echo "=== $(date -u +%FT%TZ) tunnel dead, sleeping 600s" >> "$LOG"
   sleep 600
