@@ -63,6 +63,13 @@ CONFIGS = [
                                       "memory": "none",
                                       "communicator": "allgather",
                                       "fusion": "flat"}},
+    # Fixed-cost psum majority vote (~4n bf16 on the wire, W-independent):
+    # the pod-scale route for sign methods (VERDICT round-2 item 5 asks for
+    # its on-chip compute overhead next to the packed allgather row above).
+    {"name": "signsgd_vote", "params": {"compressor": "signsgd",
+                                        "memory": "none",
+                                        "communicator": "sign_allreduce",
+                                        "fusion": "flat"}},
     {"name": "onebit",     "params": {"compressor": "onebit",
                                       "memory": "residual",
                                       "communicator": "allgather",

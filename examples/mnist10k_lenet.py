@@ -29,16 +29,11 @@ import optax
 
 import common  # noqa: E402 — sys.path bootstrap so grace_tpu imports resolve
 from grace_tpu import grace_from_params
-from grace_tpu.data import mnist_split_dataset
 from grace_tpu.models import lenet
 from grace_tpu.parallel import batch_sharded, data_parallel_mesh
 from grace_tpu.train import (init_stateful_train_state,
                              make_stateful_train_step)
 from grace_tpu.utils import TableLogger, Timer, rank_zero_print, wire_report
-
-BUNDLED_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "data", "MNIST", "raw")
-
 
 def run(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
@@ -47,25 +42,32 @@ def run(argv=None):
     parser.add_argument("--batch-size", type=int, default=256,
                         help="global batch (split across the mesh)")
     parser.add_argument("--lr", type=float, default=0.02)
-    parser.add_argument("--data-dir", default=BUNDLED_DIR,
+    parser.add_argument("--cosine-lr", action="store_true",
+                        help="cosine-decay the LR to 0 over the run (sign "
+                             "methods need decay — fixed-step signSGD "
+                             "wanders once near the optimum)")
+    parser.add_argument("--sgd-momentum", type=float, default=0.9,
+                        help="heavy-ball momentum of the outer SGD (use 0 "
+                             "for signsgd: the vote output is ±1 per "
+                             "coordinate, and momentum multiplies that "
+                             "fixed-magnitude step ~10x into divergence)")
+    parser.add_argument("--data-dir", default=common.BUNDLED_MNIST_DIR,
                         help="directory with the MNIST t10k idx(.gz) files")
     parser.add_argument("--tsv", default=None,
                         help="write per-epoch log (epoch\\tloss\\tacc) here")
     args = parser.parse_args(argv)
 
     mesh = data_parallel_mesh()
-    train = mnist_split_dataset(args.data_dir, train=True)
-    test = mnist_split_dataset(args.data_dir, train=False)
-    x_train = train.normalize(train.images)
-    y_train = train.labels
-    # Eval uses the train stats (the torchvision convention).
-    x_test = train.normalize(test.images)
-    y_test = test.labels
+    x_train, y_train, x_test, y_test = common.load_mnist_auto(args.data_dir)
     rank_zero_print(f"real MNIST: {len(x_train)} train / {len(x_test)} test")
 
     grace = grace_from_params(common.grace_params_from_args(args))
-    optimizer = optax.chain(grace.transform(seed=args.seed),
-                            optax.sgd(args.lr, momentum=0.9))
+    steps_per_epoch = max(1, len(x_train) // args.batch_size)
+    lr = optax.cosine_decay_schedule(args.lr, args.epochs * steps_per_epoch) \
+        if args.cosine_lr else args.lr
+    optimizer = optax.chain(
+        grace.transform(seed=args.seed),
+        optax.sgd(lr, momentum=args.sgd_momentum or None))
     params, mstate = lenet.init(jax.random.key(args.seed))
     rank_zero_print("wire cost:", wire_report(grace.compressor, params))
 

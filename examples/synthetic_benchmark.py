@@ -63,6 +63,39 @@ def build(args, mesh):
                     (n, args.image_size, args.image_size, 3)), jnp.float32),
                 jnp.asarray(rng.integers(0, args.num_classes, (n,)),
                             jnp.int32))
+    elif args.model == "benchnet":
+        # The exact architecture of torch_synthetic_benchmark.py's BenchNet
+        # (conv 3→32 s2, conv 32→64 s2, global mean pool, fc 64→512→512→C,
+        # biased convs like torch.nn.Conv2d) so `--model benchnet` here vs
+        # the torch script is a same-model frontend-overhead comparison
+        # (TRAINING.md "Interop overhead").
+        from grace_tpu.models import layers as L
+        keys = L.split_keys(jax.random.key(args.seed), 5)
+        params = {"conv1": L.conv_init(keys[0], 3, 3, 3, 32, use_bias=True),
+                  "conv2": L.conv_init(keys[1], 3, 3, 32, 64, use_bias=True),
+                  "fc1": L.dense_init(keys[2], 64, 512),
+                  "fc2": L.dense_init(keys[3], 512, 512),
+                  "fc3": L.dense_init(keys[4], 512, args.num_classes)}
+        mstate = {}
+
+        def loss_fn(params, mstate, batch):
+            x, y = batch
+            x = x.astype(common.compute_dtype())
+            x = jax.nn.relu(L.conv_apply(params["conv1"], x, stride=2))
+            x = jax.nn.relu(L.conv_apply(params["conv2"], x, stride=2))
+            x = x.mean(axis=(1, 2))
+            x = jax.nn.relu(L.dense_apply(params["fc1"], x))
+            x = jax.nn.relu(L.dense_apply(params["fc2"], x))
+            logits = L.dense_apply(params["fc3"], x).astype(jnp.float32)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return loss.mean(), mstate
+
+        rng = np.random.default_rng(args.seed)
+        n = args.batch_size * mesh.devices.size
+        data = (jnp.asarray(rng.standard_normal(
+                    (n, args.image_size, args.image_size, 3)), jnp.float32),
+                jnp.asarray(rng.integers(0, args.num_classes, (n,)),
+                            jnp.int32))
     elif args.model == "bert":
         cfg = transformer.base(num_classes=args.num_classes)
         params, mstate = transformer.init(jax.random.key(args.seed), cfg)
@@ -90,7 +123,8 @@ def main():
     common.add_grace_args(parser)
     parser.add_argument("--model", default="resnet50",
                         help="resnet50|resnet101|resnet152|vgg{11,13,16,19}"
-                             "[_bn]|bert")
+                             "[_bn]|bert|benchnet (the torch interop "
+                             "benchmark's model, for frontend comparisons)")
     parser.add_argument("--batch-size", type=int, default=32,
                         help="per-device batch (reference default 32)")
     parser.add_argument("--image-size", type=int, default=224)

@@ -19,6 +19,7 @@ would serialise W collectives for an identical result.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -180,9 +181,13 @@ def _join_ctx(treedef, static, arrays):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+@functools.lru_cache(maxsize=256)
 def ctx_is_data_free(compressor: Compressor, n: int, dtype) -> bool:
     """True iff no ctx array leaf of ``compressor.compress`` depends on the
-    *data* (rng-derived and constant leaves are fine).
+    *data* (rng-derived and constant leaves are fine). Cached per
+    (compressor, n, dtype) — compressors are frozen dataclasses, so the
+    answer is a pure config property and the extra compress trace is paid
+    once, not per leaf per jit trace.
 
     TwoShotAllreduce decodes every rank's gathered stage-2 chunk with the
     rank-local ctx2 from compressing this rank's own (rank-divergent)

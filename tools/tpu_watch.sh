@@ -10,19 +10,29 @@
 # Usage: setsid nohup tools/tpu_watch.sh &   (log: tpu_watch.log at repo root)
 cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watch.log
+BENCH_ATTEMPTS=0
+MAX_BENCH_ATTEMPTS=5   # cap: a deterministic bench bug must not re-burn the
+                       # shared chip for hours per loop iteration forever
 while true; do
   echo "=== $(date -u +%FT%TZ) probing" >> "$LOG"
   if timeout 300 python -c \
       "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" \
       >> "$LOG" 2>&1; then
-    echo "=== $(date -u +%FT%TZ) tunnel ALIVE — headline bench" >> "$LOG"
+    BENCH_ATTEMPTS=$((BENCH_ATTEMPTS + 1))
+    echo "=== $(date -u +%FT%TZ) tunnel ALIVE — headline bench" \
+         "(attempt $BENCH_ATTEMPTS/$MAX_BENCH_ATTEMPTS)" >> "$LOG"
     timeout 1800 python bench.py --_worker tpu >> "$LOG" 2>&1
     rc1=$?
     echo "=== headline rc=$rc1" >> "$LOG"
-    echo "=== $(date -u +%FT%TZ) per-algorithm sweep" >> "$LOG"
-    timeout 9000 python bench_all.py --_worker tpu >> "$LOG" 2>&1
-    rc2=$?
-    echo "=== sweep rc=$rc2" >> "$LOG"
+    rc2=1
+    if [ "$rc1" -eq 0 ]; then
+      # Headline failure usually means the tunnel died again — skip the
+      # 2.5h sweep in that case and go straight back to probing.
+      echo "=== $(date -u +%FT%TZ) per-algorithm sweep" >> "$LOG"
+      timeout 9000 python bench_all.py --_worker tpu >> "$LOG" 2>&1
+      rc2=$?
+      echo "=== sweep rc=$rc2" >> "$LOG"
+    fi
     # Only retire the watcher once BOTH measurements actually landed —
     # a tunnel that dies mid-bench must put us back into the probe loop
     # (partial rows are already persisted by the workers either way).
@@ -31,8 +41,15 @@ while true; do
         >> "$LOG"
       break
     fi
-    echo "=== $(date -u +%FT%TZ) bench(es) failed, back to probing" >> "$LOG"
+    if [ "$BENCH_ATTEMPTS" -ge "$MAX_BENCH_ATTEMPTS" ]; then
+      echo "=== $(date -u +%FT%TZ) bench attempt cap reached — watcher" \
+           "stopping with partial evidence" >> "$LOG"
+      break
+    fi
+    echo "=== $(date -u +%FT%TZ) bench(es) failed, sleeping 600s before" \
+         "re-probe" >> "$LOG"
+  else
+    echo "=== $(date -u +%FT%TZ) tunnel dead, sleeping 600s" >> "$LOG"
   fi
-  echo "=== $(date -u +%FT%TZ) tunnel dead, sleeping 600s" >> "$LOG"
   sleep 600
 done
