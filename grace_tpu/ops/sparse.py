@@ -21,3 +21,24 @@ def scatter_dense(values: jax.Array, indices: jax.Array, numel: int,
     """
     flat = jnp.zeros((numel,), values.dtype).at[indices].set(values)
     return flat.reshape(shape)
+
+
+def chunkwise_dense(values: jax.Array, win_row: jax.Array, rows: int,
+                    numel: int, shape: tuple) -> jax.Array:
+    """Scatter-free dense build for chunk-structured sparsity.
+
+    For payloads where exactly one element per column of the (rows, k)
+    row-major view of the flat tensor is kept (TopKCompressor
+    ``algorithm='chunk'``), the dense tensor is a one-hot row-select per
+    column — a single fused elementwise comparison instead of a scatter.
+    TPU scatter serializes (measured: it dominates the Top-K pipeline on a
+    25.5M-element fused gradient); this build is pure VPU work at the same
+    O(n) cost as one elementwise pass.
+
+    ``values``/``win_row`` have length k; element c lands at flat index
+    ``win_row[c] * k + c``. Padding columns introduced at compress time
+    carry value 0, so rows*k > numel overhang truncates harmlessly.
+    """
+    mask = jnp.arange(rows, dtype=win_row.dtype)[:, None] == win_row[None, :]
+    dense = jnp.where(mask, values[None, :], jnp.zeros((), values.dtype))
+    return dense.reshape(-1)[:numel].reshape(shape)

@@ -297,6 +297,40 @@ class TestTopKAlgorithms:
         the tail padding spanned whole chunks; strided chunking cannot."""
         self._roundtrip("chunk", n=n, ratio=ratio)
 
+    @pytest.mark.parametrize("n,ratio", [
+        (10_000, 0.01),
+        (27, 0.3),
+        (25_557, 0.01),
+        (101, 0.5),
+    ])
+    def test_chunk_onehot_decompress_matches_scatter(self, n, ratio):
+        """Chunk mode's scatter-free one-hot decompress must equal the
+        general scatter build bit-exactly for every payload."""
+        from grace_tpu.compressors import TopKCompressor
+        from grace_tpu.ops.sparse import scatter_dense
+
+        c = TopKCompressor(compress_ratio=ratio, algorithm="chunk")
+        x = jax.random.normal(jax.random.key(3), (n,))
+        (vals, idx), ctx, _ = c.compress(x, None, jax.random.key(0))
+        numel, shape, dtype = ctx
+        got = c.decompress((vals, idx), ctx)
+        want = scatter_dense(vals.astype(dtype), idx, numel, shape)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_chunk_subk_payload_falls_back_to_scatter(self):
+        """A sliced payload (TwoShot per-rank slice) loses the full-column
+        structure; decompress must route it through the general scatter."""
+        from grace_tpu.compressors import TopKCompressor
+        from grace_tpu.ops.sparse import scatter_dense
+
+        c = TopKCompressor(compress_ratio=0.01, algorithm="chunk")
+        x = jax.random.normal(jax.random.key(5), (10_000,))
+        (vals, idx), ctx, _ = c.compress(x, None, jax.random.key(0))
+        sub = (vals[:40], idx[:40])                    # 40 < k=100
+        got = c.decompress(sub, ctx)
+        want = scatter_dense(sub[0], sub[1], *ctx[:2])
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_unknown_algorithm_rejected(self):
         from grace_tpu.compressors import TopKCompressor
         with pytest.raises(ValueError, match="algorithm"):

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Full 24-epoch CIFAR-10 DAWNBench runs on the 8-device CPU mesh (VERDICT
+# round-2 item 4): uncompressed, Top-K 1% + residual, and Top-K 1% through
+# the two-shot communicator. Sequential — the host has one core. Writes its
+# process-group id to /tmp/cifar_runs.pgid so tools/tpu_watch.sh can
+# SIGSTOP/SIGCONT the group around TPU measurements (host contention would
+# otherwise leak into the fetch-bounded timing windows).
+#
+# Usage: setsid nohup tools/cifar_runs.sh & (log: cifar_runs.log at repo root)
+cd "$(dirname "$0")/.." || exit 1
+echo $$ > /tmp/cifar_runs.pgid
+# Abnormal exit must not leave a stale pgid for tpu_watch.sh to SIGSTOP
+# after the kernel recycles it for an unrelated process group.
+trap 'rm -f /tmp/cifar_runs.pgid' EXIT
+LOG=cifar_runs.log
+run() {
+  echo "=== $(date -u +%FT%TZ) $*" >> "$LOG"
+  python examples/cifar10_dawn.py --epochs 24 "$@" >> "$LOG" 2>&1
+  echo "=== rc=$?" >> "$LOG"
+}
+run --tsv examples/logs/cifar10_dawn_24ep.tsv
+run --compressor topk --compress-ratio 0.01 --memory residual --peak-lr 0.1 \
+    --tsv examples/logs/cifar10_dawn_24ep_topk1pct.tsv
+run --compressor topk --compress-ratio 0.01 --memory residual --peak-lr 0.1 \
+    --communicator twoshot \
+    --tsv examples/logs/cifar10_dawn_24ep_topk1pct_twoshot.tsv
+rm -f /tmp/cifar_runs.pgid
+echo "=== $(date -u +%FT%TZ) all done" >> "$LOG"

@@ -11,6 +11,19 @@
 cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watch.log
 BENCH_ATTEMPTS=0
+
+# The host has one core: pause any long-running CPU-mesh training
+# (tools/cifar_runs.sh) for the duration of a TPU measurement so host
+# contention cannot leak into the fetch-bounded timing windows.
+pause_cpu_jobs() {
+  [ -f /tmp/cifar_runs.pgid ] && kill -STOP -"$(cat /tmp/cifar_runs.pgid)" \
+    2>/dev/null && echo "=== paused cifar_runs" >> "$LOG"
+}
+resume_cpu_jobs() {
+  [ -f /tmp/cifar_runs.pgid ] && kill -CONT -"$(cat /tmp/cifar_runs.pgid)" \
+    2>/dev/null && echo "=== resumed cifar_runs" >> "$LOG"
+}
+trap resume_cpu_jobs EXIT
 MAX_BENCH_ATTEMPTS=5   # cap: a deterministic bench bug must not re-burn the
                        # shared chip for hours per loop iteration forever
 while true; do
@@ -21,6 +34,7 @@ while true; do
     BENCH_ATTEMPTS=$((BENCH_ATTEMPTS + 1))
     echo "=== $(date -u +%FT%TZ) tunnel ALIVE — headline bench" \
          "(attempt $BENCH_ATTEMPTS/$MAX_BENCH_ATTEMPTS)" >> "$LOG"
+    pause_cpu_jobs
     timeout 1800 python bench.py --_worker tpu >> "$LOG" 2>&1
     rc1=$?
     echo "=== headline rc=$rc1" >> "$LOG"
@@ -33,6 +47,7 @@ while true; do
       rc2=$?
       echo "=== sweep rc=$rc2" >> "$LOG"
     fi
+    resume_cpu_jobs
     # Only retire the watcher once BOTH measurements actually landed —
     # a tunnel that dies mid-bench must put us back into the probe loop
     # (partial rows are already persisted by the workers either way).
