@@ -1,0 +1,118 @@
+"""Microbenchmark the Top-K pipeline pieces on the chip.
+
+The headline gap (chunk Top-K 0.55x dense, BENCH_TPU_LAST.json 2026-07-31)
+is ~10 ms/step of overhead on a 25.5M-element fused gradient. This times
+each stage of the GRACE pipeline in isolation so the fix targets the
+measured hot spot instead of a guess.
+
+Method: repetition runs ON DEVICE via lax.fori_loop with a data-dependent
+carry, one dispatch per measurement — a Python-loop-of-dispatches floors
+every op at the tunnel's ~5 ms per-dispatch overhead and reads pure noise
+(first version of this tool did exactly that: an elementwise add "measured"
+5.5 ms). The carry feeds each iteration's input so XLA cannot hoist the
+body out of the loop; the reported per-iter time includes one carry add
+(~0.1 ms), negligible against the ops under test.
+
+Usage (on the chip): python tools/tpu_micro.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = 25_557_032          # ResNet-50 fused gradient element count
+K = N // 100
+ITERS = 20
+
+
+def timed(name, make_body, *args):
+    """make_body(carry, *args) -> new carry (same shape/dtype as carry)."""
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def run(c0, *a):
+        def body(i, c):
+            # i-dependent perturbation pins the body inside the loop.
+            return make_body(c + i * 1e-12, *a)
+        return lax.fori_loop(0, ITERS, body, c0)
+
+    c0 = args[0] * 0.0 + 1.0 if False else None  # placeholder, unused
+    import jax.numpy as jnp
+    c0 = jnp.zeros((N,), jnp.float32)
+    out = run(c0, *args)
+    out.block_until_ready()
+    float(out[0])
+    t0 = time.perf_counter()
+    out = run(c0, *args)
+    float(out[0])
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:34s} {dt*1e3:8.3f} ms/iter", flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    assert jax.devices()[0].platform == "tpu"
+    flat = jax.random.normal(jax.random.key(0), (N,), jnp.float32)
+    resid = jax.random.normal(jax.random.key(1), (N,), jnp.float32)
+    k = K
+    rows = -(-N // k)
+    idx0 = jnp.arange(k, dtype=jnp.int32) * rows  # spread, in-range indices
+    vals0 = jnp.ones((k,), jnp.float32)
+    wr0 = jnp.zeros((k,), jnp.int32)
+
+    print(f"n={N} k={k} rows={rows} iters={ITERS}", flush=True)
+
+    timed("carry add only (baseline)", lambda c: c)
+    timed("elementwise add", lambda c: c + resid)
+    timed("abs+pad+argmax (chunk select)", lambda c: c.at[0].add(
+        jnp.argmax(jnp.full((rows * k,), -1.0, c.dtype).at[:N]
+                   .set(jnp.abs(c)).reshape(rows, k), axis=0)
+        .astype(c.dtype).sum() * 1e-20))
+    timed("approx_max_k", lambda c: c.at[0].add(
+        lax.approx_max_k(jnp.abs(c), k, recall_target=0.95)[0].sum() * 1e-20))
+    timed("gather flat[idx] (k)", lambda c: c.at[0].add(
+        c[idx0].sum() * 1e-20))
+    timed("scatter k into n", lambda c:
+          jnp.zeros((N,), c.dtype).at[idx0].set(c[:k] * 0 + vals0) + c * 1e-20)
+    timed("one-hot k into n", lambda c:
+          jnp.where(jnp.arange(rows, dtype=jnp.int32)[:, None]
+                    == (wr0 + c[0].astype(jnp.int32) * 0)[None, :],
+                    vals0[None, :], 0.0).reshape(-1)[:N] + c * 1e-20)
+
+    def full_pipeline(c):
+        comp = c + resid
+        body = jnp.full((rows * k,), -1.0, comp.dtype)
+        body = body.at[:N].set(jnp.abs(comp)).reshape(rows, k)
+        win_row = jnp.argmax(body, axis=0).astype(jnp.int32)
+        idx = win_row * k + jnp.arange(k, dtype=jnp.int32)
+        vals = comp[idx]
+        mask = jnp.arange(rows, dtype=jnp.int32)[:, None] == win_row[None, :]
+        dense = jnp.where(mask, vals[None, :], 0.0).reshape(-1)[:N]
+        return comp - dense          # new residual: the carried state
+
+    timed("full chunk pipeline", full_pipeline)
+
+    def gatherfree_pipeline(c):
+        comp = c + resid
+        sbody = jnp.zeros((rows * k,), comp.dtype).at[:N].set(comp)
+        sbody = sbody.reshape(rows, k)
+        win_row = jnp.argmax(jnp.abs(sbody).at[-1].add(-1e-9), axis=0)
+        mask = (jnp.arange(rows, dtype=jnp.int32)[:, None]
+                == win_row.astype(jnp.int32)[None, :])
+        dense = jnp.where(mask, sbody, 0.0)
+        vals = jnp.sum(dense, axis=0)             # wire values, gather-free
+        return comp - (dense.reshape(-1)[:N] + vals[0] * 1e-20)
+
+    timed("gather-free chunk pipeline", gatherfree_pipeline)
+
+
+if __name__ == "__main__":
+    main()

@@ -15,13 +15,26 @@ BENCH_ATTEMPTS=0
 # The host has one core: pause any long-running CPU-mesh training
 # (tools/cifar_runs.sh) for the duration of a TPU measurement so host
 # contention cannot leak into the fetch-bounded timing windows.
+# Identity check before signalling: the pgid file can go stale (SIGKILL/
+# OOM skips cifar_runs.sh's EXIT trap) and the kernel recycles pgids — an
+# unverified kill -STOP could freeze an unrelated process group for the
+# length of a 2.5h sweep.
+cifar_pgid() {
+  local pgid
+  [ -f /tmp/cifar_runs.pgid ] || return 1
+  pgid=$(cat /tmp/cifar_runs.pgid) || return 1
+  grep -qa cifar_runs "/proc/$pgid/cmdline" 2>/dev/null || return 1
+  echo "$pgid"
+}
 pause_cpu_jobs() {
-  [ -f /tmp/cifar_runs.pgid ] && kill -STOP -"$(cat /tmp/cifar_runs.pgid)" \
-    2>/dev/null && echo "=== paused cifar_runs" >> "$LOG"
+  local pgid
+  pgid=$(cifar_pgid) && kill -STOP -"$pgid" 2>/dev/null \
+    && echo "=== paused cifar_runs" >> "$LOG"
 }
 resume_cpu_jobs() {
-  [ -f /tmp/cifar_runs.pgid ] && kill -CONT -"$(cat /tmp/cifar_runs.pgid)" \
-    2>/dev/null && echo "=== resumed cifar_runs" >> "$LOG"
+  local pgid
+  pgid=$(cifar_pgid) && kill -CONT -"$pgid" 2>/dev/null \
+    && echo "=== resumed cifar_runs" >> "$LOG"
 }
 trap resume_cpu_jobs EXIT
 MAX_BENCH_ATTEMPTS=5   # cap: a deterministic bench bug must not re-burn the
