@@ -45,12 +45,74 @@ class TopKCompressor(Compressor):
     algorithm: str = "exact"      # 'exact' | 'approx' | 'chunk'
     recall_target: float = 0.95   # for 'approx'
     wire_dtype: str = "float32"   # 'float32' | 'bfloat16' wire values
+    # Fused Pallas TPU kernel for the chunk-mode LOCAL pipeline (compensate
+    # + select + value extract + residual update in one HBM pass — see
+    # grace_tpu/ops/pallas_topk.py), used via the Communicator.step fast
+    # path with linear-error-feedback memories. 'auto': on for TPU, plain
+    # XLA elsewhere; True forces interpret mode off-TPU (tests).
+    use_pallas: bool | str = "auto"
 
     def __post_init__(self):
         if self.algorithm not in ("exact", "approx", "chunk"):
             raise ValueError(f"unknown topk algorithm {self.algorithm!r}")
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
+
+    def _pallas_mode(self):
+        if self.use_pallas == "auto":
+            return jax.default_backend() == "tpu", False
+        if self.use_pallas:
+            return True, jax.default_backend() != "tpu"
+        return False, False
+
+    def fused_feedback_compress(self, x: jax.Array, state, coeffs,
+                                rng: jax.Array, world=lambda: 1):
+        """Communicator.step fused fast path (one-HBM-pass local pipeline).
+
+        ``coeffs = (beta, gamma)`` is the paired memory's declared linear
+        feedback ``compensate = beta*state + gamma*x``; returns
+        ``(payload, ctx, new_residual_state)`` bit-identical to
+        compensate -> compress -> update, or None when this config cannot
+        take the fast path (non-chunk algorithm, Pallas disabled, a
+        degenerate k, non-f32 buffers, or rows that overflow the kernel's
+        VMEM block budget). ``world`` is a zero-arg thunk for the mesh axis
+        size — only queried in interpreter mode, so the staged path keeps
+        working outside shard_map.
+        """
+        if self.algorithm != "chunk":
+            return None
+        enabled, interpret = self._pallas_mode()
+        if not enabled:
+            return None
+        if x.dtype != jnp.float32 or (state is not None
+                                      and state.dtype != jnp.float32):
+            # The kernel computes in f32; a bf16 gradient buffer through the
+            # staged path ships bf16 wire values and compensates in bf16 —
+            # the fused path would change both wire size and numerics.
+            return None
+        if interpret and world() > 1:
+            # Interpreter-mode Pallas deadlocks inside a multi-device
+            # shard_map program on CPU (observed: one 8-device step hangs
+            # >7 min where the 1-device step takes milliseconds). The
+            # compiled TPU kernel has no such restriction; off-TPU the
+            # fused path is for single-device correctness tests only.
+            return None
+        shape, numel = x.shape, x.size
+        k = static_k(numel, self.compress_ratio)
+        if numel < 2 * k:
+            return None
+        from grace_tpu.ops.pallas_topk import (block_cols,
+                                               chunk_compress_feedback)
+        if block_cols(numel // k) <= 0:
+            return None                     # tiny ratio => too many rows
+        beta, gamma = coeffs
+        resid = None if state is None else state.reshape(-1)
+        values, win_row, new_resid = chunk_compress_feedback(
+            x.reshape(-1), resid, k, beta=float(beta), gamma=float(gamma),
+            wire_bf16=self.wire_dtype == "bfloat16", interpret=interpret)
+        indices = win_row * k + jnp.arange(k, dtype=jnp.int32)
+        new_state = None if state is None else new_resid.reshape(state.shape)
+        return ((values, indices), (numel, shape, x.dtype), new_state)
 
     def _select(self, flat: jax.Array, k: int) -> jax.Array:
         if self.algorithm == "approx" and flat.size > 4 * k:

@@ -164,7 +164,23 @@ class Communicator:
 
         Mirrors grace_dl/dist/__init__.py:47-52 but returns next states
         functionally instead of mutating dicts.
+
+        Fused fast path: when the memory declares linear error feedback
+        (``linear_feedback_coeffs``: compensate = β·state + γ·x, update =
+        compensated − decompress) and the compressor offers
+        ``fused_feedback_compress`` (e.g. chunk-mode Top-K's one-HBM-pass
+        Pallas kernel, ops/pallas_topk.py), the three local stages collapse
+        into one call with bit-identical semantics.
         """
+        coeffs = getattr(memory, "linear_feedback_coeffs", None)
+        fused = getattr(compressor, "fused_feedback_compress", None)
+        if coeffs is not None and fused is not None and mem_state is not None:
+            fused_out = fused(x, mem_state, coeffs, rng,
+                              world=lambda: lax.axis_size(self.axis_name))
+            if fused_out is not None:
+                payload, ctx, mem_state = fused_out
+                out = self.exchange(payload, ctx, compressor)
+                return out, mem_state, comp_state
         compensated, mem_state = memory.compensate(x, mem_state)
         payload, ctx, comp_state = compressor.compress(compensated, comp_state, rng)
         mem_state = memory.update(compensated, payload, ctx, compressor, mem_state)

@@ -56,7 +56,8 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
             compress_ratio=ratio,
             algorithm=params.get("topk_algorithm", "exact"),
             recall_target=params.get("recall_target", 0.95),
-            wire_dtype=params.get("wire_dtype", "float32"))
+            wire_dtype=params.get("wire_dtype", "float32"),
+            use_pallas=params.get("use_pallas", "auto"))
     if name == "randomk":
         return C.RandomKCompressor(compress_ratio=ratio)
     if name == "threshold":

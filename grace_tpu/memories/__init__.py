@@ -41,6 +41,13 @@ class ResidualMemory(Memory):
     beta: float = 1.0
     gamma: float = 1.0
 
+    @property
+    def linear_feedback_coeffs(self):
+        """Declares ``compensate = beta*state + gamma*x`` with
+        ``update = compensated - decompress`` — the contract the
+        Communicator.step fused fast path (core.py) relies on."""
+        return (self.beta, self.gamma)
+
     def init_state(self, x: jax.Array) -> State:
         return jnp.zeros_like(x)
 
@@ -61,6 +68,11 @@ class EFSignSGDMemory(Memory):
     """
 
     lr: float = 0.1
+
+    @property
+    def linear_feedback_coeffs(self):
+        """``compensate = 1.0*state + lr*x`` (see ResidualMemory)."""
+        return (1.0, self.lr)
 
     def init_state(self, x: jax.Array) -> State:
         return jnp.zeros_like(x)
