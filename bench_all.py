@@ -53,6 +53,16 @@ CONFIGS = [
                                          "memory": "residual",
                                          "communicator": "allgather",
                                          "fusion": "flat"}},
+    # Ablation: chunk selection WITHOUT the fused Pallas local pipeline
+    # (ops/pallas_topk.py) — quantifies the kernel's on-chip win vs the
+    # staged XLA path (headline row runs with use_pallas='auto' = on).
+    {"name": "topk1pct_nopallas", "params": {"compressor": "topk",
+                                             "compress_ratio": 0.01,
+                                             "topk_algorithm": "chunk",
+                                             "use_pallas": False,
+                                             "memory": "residual",
+                                             "communicator": "allgather",
+                                             "fusion": "flat"}},
     {"name": "qsgd",       "params": {"compressor": "qsgd",
                                       "quantum_num": 64,
                                       "memory": "none",

@@ -69,8 +69,10 @@ def add_grace_args(parser: argparse.ArgumentParser) -> None:
                    help="exact|approx|chunk — top-k selection strategy")
     g.add_argument("--recall-target", type=float, default=0.95,
                    help="recall for --topk-algorithm approx")
-    g.add_argument("--use-pallas", action="store_true",
-                   help="fused Pallas quantization kernel (qsgd)")
+    g.add_argument("--use-pallas", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="fused Pallas kernels (qsgd quantize, chunk top-k "
+                        "local pipeline): auto = on for TPU only")
     g.add_argument("--seed", type=int, default=42)
 
 
@@ -92,7 +94,8 @@ def grace_params_from_args(args) -> dict:
         "fusion": fusion,
         "topk_algorithm": args.topk_algorithm,
         "recall_target": args.recall_target,
-        "use_pallas": args.use_pallas,
+        "use_pallas": {"auto": "auto", "on": True,
+                       "off": False}[args.use_pallas],
     }
 
 

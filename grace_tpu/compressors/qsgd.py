@@ -27,6 +27,13 @@ class QSGDCompressor(Compressor):
     # interpreter-mode off elsewhere; True forces interpret mode off-TPU.
     use_pallas: bool | str = False
 
+    def __post_init__(self):
+        if not (self.use_pallas in ("auto", True, False)):
+            # A truthy string like 'off' would silently force the kernel ON
+            # through _pallas_mode's truthiness check.
+            raise ValueError(f"use_pallas must be True, False or 'auto'; "
+                             f"got {self.use_pallas!r}")
+
     def _pallas_mode(self):
         if self.use_pallas == "auto":
             return jax.default_backend() == "tpu", False

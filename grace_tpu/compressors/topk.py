@@ -57,6 +57,11 @@ class TopKCompressor(Compressor):
             raise ValueError(f"unknown topk algorithm {self.algorithm!r}")
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
+        if not (self.use_pallas in ("auto", True, False)):
+            # A truthy string like 'off' would silently force the kernel ON
+            # through _pallas_mode's truthiness check.
+            raise ValueError(f"use_pallas must be True, False or 'auto'; "
+                             f"got {self.use_pallas!r}")
 
     def _pallas_mode(self):
         if self.use_pallas == "auto":
