@@ -299,7 +299,14 @@ def bench_configs(platform: str, configs, emit) -> None:
         print(f"[bench] {cfg['name']}: {best:.2f} imgs/sec"
               + (f", mfu={mfu:.4f}" if mfu is not None else ""),
               file=sys.stderr, flush=True)
+        row_extra = {}
+        if os.environ.get("GRACE_DISABLE_PALLAS"):
+            # The escape hatch means this row measured the staged XLA path
+            # even for configs whose default is the Pallas kernel — the
+            # evidence must say so, not attribute the number to the kernel.
+            row_extra["env_pallas_disabled"] = True
         emit({
+            **row_extra,
             "config": cfg["name"],
             "imgs_per_sec": round(best, 2),
             "vs_baseline": round(best / baseline, 4),

@@ -64,6 +64,9 @@ class TopKCompressor(Compressor):
                              f"got {self.use_pallas!r}")
 
     def _pallas_mode(self):
+        from grace_tpu.ops import pallas_disabled
+        if pallas_disabled(explicit=self.use_pallas is True):
+            return False, False
         if self.use_pallas == "auto":
             return jax.default_backend() == "tpu", False
         if self.use_pallas:

@@ -1,6 +1,25 @@
+import os
+import warnings
+
 from grace_tpu.ops.packing import (pack_2bit, pack_bits, unpack_2bit,
                                    unpack_bits)
 from grace_tpu.ops.sparse import scatter_dense
 
 __all__ = ["pack_bits", "unpack_bits", "pack_2bit", "unpack_2bit",
-           "scatter_dense"]
+           "scatter_dense", "pallas_disabled"]
+
+
+def pallas_disabled(explicit: bool = False) -> bool:
+    """Operational escape hatch: GRACE_DISABLE_PALLAS forces every Pallas
+    kernel off (set by tools/tpu_watch.sh when the on-chip smoke test
+    fails) so a Mosaic compile failure cannot take down a whole run.
+    Warns when it defeats an explicit ``use_pallas=True`` — a forgotten
+    export would otherwise turn the kernel equivalence tests into vacuous
+    staged-vs-staged comparisons."""
+    if not os.environ.get("GRACE_DISABLE_PALLAS"):
+        return False
+    if explicit:
+        warnings.warn("GRACE_DISABLE_PALLAS is set: overriding explicit "
+                      "use_pallas=True; Pallas kernels will NOT run",
+                      RuntimeWarning, stacklevel=3)
+    return True

@@ -13,6 +13,13 @@ echo $$ > /tmp/cifar_runs.pgid
 # after the kernel recycles it for an unrelated process group.
 trap 'rm -f /tmp/cifar_runs.pgid' EXIT
 LOG=cifar_runs.log
+# Pin the 8-device simulated-CPU mesh BEFORE python starts — without this
+# the example latches onto the TPU tunnel (sitecustomize), racing the
+# benches for the one real chip when it is up and dying in backend init
+# when it is not (observed: two runs burned 40 min each hanging in axon
+# init, rc=1, zero epochs).
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS=--xla_force_host_platform_device_count=8
 run() {
   echo "=== $(date -u +%FT%TZ) $*" >> "$LOG"
   python examples/cifar10_dawn.py --epochs 24 "$@" >> "$LOG" 2>&1

@@ -1,0 +1,63 @@
+"""60-second on-chip smoke test for the Pallas kernels.
+
+tpu_watch.sh runs this right after a successful tunnel probe and BEFORE the
+benches: the fused chunk-Top-K kernel (ops/pallas_topk.py) is on the
+headline path (use_pallas='auto'), so a Mosaic compile failure on the real
+chip would otherwise crash every bench attempt. On failure the watcher
+exports GRACE_DISABLE_PALLAS=1 so the benches measure the staged XLA path
+instead of measuring nothing.
+
+Exit 0 = kernel compiled and matches the staged path on-device.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.devices()[0].platform != "tpu":
+        print("smoke: not on tpu", file=sys.stderr)
+        return 2
+
+    from grace_tpu.compressors import TopKCompressor
+    from grace_tpu.ops.pallas_topk import chunk_compress_feedback
+
+    n, ratio = 1_000_000, 0.01
+    k = max(1, int(n * ratio))
+    flat = jax.random.normal(jax.random.key(0), (n,), jnp.float32)
+    resid = jax.random.normal(jax.random.key(1), (n,), jnp.float32) * 0.1
+
+    vals, win, new_resid = chunk_compress_feedback(flat, resid, k)
+    vals, win, new_resid = map(np.asarray, (vals, win, new_resid))
+
+    ref = TopKCompressor(compress_ratio=ratio, algorithm="chunk",
+                         use_pallas=False)
+    payload, ctx, _ = ref.compress(flat + resid, None, jax.random.key(2))
+    rvals, ridx = map(np.asarray, payload)
+
+    idx = win * k + np.arange(k)
+    if not np.array_equal(idx, ridx):
+        print("smoke: index mismatch", file=sys.stderr)
+        return 1
+    if not np.array_equal(vals, rvals):
+        print("smoke: value mismatch", file=sys.stderr)
+        return 1
+    dense = np.zeros(n, np.float32)
+    dense[idx] = vals
+    if not np.array_equal(new_resid, np.asarray(flat + resid) - dense):
+        print("smoke: residual mismatch", file=sys.stderr)
+        return 1
+    print("smoke: pallas chunk-topk kernel OK on", jax.devices()[0])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
