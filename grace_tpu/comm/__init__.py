@@ -112,6 +112,11 @@ class Allgather(Communicator):
         gathered = tuple(
             lax.all_gather(t, self.axis_name, axis=0, tiled=False)
             for t in payload)
+        fused = getattr(compressor, "fused_aggregate_decompress", None)
+        if fused is not None:
+            out = fused(gathered, ctx, lax.axis_size(self.axis_name))
+            if out is not None:      # handles aggregate + average itself
+                return out
         stacked = jax.vmap(lambda p: compressor.decompress(p, ctx))(gathered)
         out = compressor.aggregate(stacked)
         if compressor.average:

@@ -73,6 +73,31 @@ class TopKCompressor(Compressor):
             return True, jax.default_backend() != "tpu"
         return False, False
 
+    def _fused_chunk_gate(self, numel: int, dtype, world):
+        """Shared guard for both fused fast paths. Returns (k, interpret)
+        or None when the staged path must run: non-chunk algorithm, Pallas
+        disabled, non-f32 data (the kernels compute/ship f32 — the staged
+        path works in x.dtype, so wire size and numerics would change),
+        degenerate k, or interpret mode on a multi-device mesh
+        (interpreter Pallas deadlocks inside a multi-device shard_map
+        program on CPU — observed: one 8-device step hangs >7 min where
+        the 1-device step takes milliseconds; the compiled TPU kernel has
+        no such restriction). ``world`` is a zero-arg thunk so the check
+        works outside shard_map too."""
+        if self.algorithm != "chunk":
+            return None
+        enabled, interpret = self._pallas_mode()
+        if not enabled:
+            return None
+        if dtype != jnp.float32:
+            return None
+        if interpret and world() > 1:
+            return None
+        k = static_k(numel, self.compress_ratio)
+        if numel < 2 * k:
+            return None
+        return k, interpret
+
     def fused_feedback_compress(self, x: jax.Array, state, coeffs,
                                 rng: jax.Array, world=lambda: 1):
         """Communicator.step fused fast path (one-HBM-pass local pipeline).
@@ -81,37 +106,18 @@ class TopKCompressor(Compressor):
         feedback ``compensate = beta*state + gamma*x``; returns
         ``(payload, ctx, new_residual_state)`` bit-identical to
         compensate -> compress -> update, or None when this config cannot
-        take the fast path (non-chunk algorithm, Pallas disabled, a
-        degenerate k, non-f32 buffers, or rows that overflow the kernel's
-        VMEM block budget). ``world`` is a zero-arg thunk for the mesh axis
-        size — only queried in interpreter mode, so the staged path keeps
-        working outside shard_map.
+        take the fast path (see ``_fused_chunk_gate``, plus a VMEM block
+        budget check for the row count).
         """
-        if self.algorithm != "chunk":
+        gate = self._fused_chunk_gate(x.size, x.dtype, world)
+        if gate is None or (state is not None
+                            and state.dtype != jnp.float32):
             return None
-        enabled, interpret = self._pallas_mode()
-        if not enabled:
-            return None
-        if x.dtype != jnp.float32 or (state is not None
-                                      and state.dtype != jnp.float32):
-            # The kernel computes in f32; a bf16 gradient buffer through the
-            # staged path ships bf16 wire values and compensates in bf16 —
-            # the fused path would change both wire size and numerics.
-            return None
-        if interpret and world() > 1:
-            # Interpreter-mode Pallas deadlocks inside a multi-device
-            # shard_map program on CPU (observed: one 8-device step hangs
-            # >7 min where the 1-device step takes milliseconds). The
-            # compiled TPU kernel has no such restriction; off-TPU the
-            # fused path is for single-device correctness tests only.
-            return None
+        k, interpret = gate
         shape, numel = x.shape, x.size
-        k = static_k(numel, self.compress_ratio)
-        if numel < 2 * k:
-            return None
-        from grace_tpu.ops.pallas_topk import (block_cols,
-                                               chunk_compress_feedback)
-        if block_cols(numel // k) <= 0:
+        from grace_tpu.ops.pallas_topk import (chunk_compress_feedback,
+                                               compress_block_cols)
+        if compress_block_cols(numel // k) <= 0:
             return None                     # tiny ratio => too many rows
         beta, gamma = coeffs
         resid = None if state is None else state.reshape(-1)
@@ -177,6 +183,31 @@ class TopKCompressor(Compressor):
             # is re-injected next step — same argument as 'approx' recall.
             values = values.astype(jnp.bfloat16)
         return (values, indices), (numel, shape, x.dtype), state
+
+    def fused_aggregate_decompress(self, gathered: Payload, ctx: Ctx,
+                                   world: int):
+        """Allgather fused exchange path: (world, k) payload stacks ->
+        aggregated (and world-averaged, per ``self.average``) dense tensor
+        in one n-sized HBM pass (ops/pallas_topk.py chunk_aggregate_dense),
+        replacing world vmapped one-hot builds + a sum. None = staged path.
+        """
+        numel, shape, dtype = ctx
+        gate = self._fused_chunk_gate(numel, dtype, lambda: world)
+        if gate is None:
+            return None
+        k, interpret = gate
+        values, indices = gathered
+        if values.shape != (world, k):
+            return None              # sub-k payloads lose chunk structure
+        from grace_tpu.ops.pallas_topk import (aggregate_block_cols,
+                                               chunk_aggregate_dense)
+        if aggregate_block_cols(numel // k, world) <= 0:
+            return None              # pod-scale W inflates the input blocks
+        win = (indices // k).astype(jnp.int32)
+        out = chunk_aggregate_dense(values.astype(jnp.float32), win, k,
+                                    numel, average=self.average,
+                                    interpret=interpret)
+        return out.reshape(shape).astype(dtype)
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
         values, indices = payload

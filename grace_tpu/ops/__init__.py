@@ -15,8 +15,10 @@ def pallas_disabled(explicit: bool = False) -> bool:
     fails) so a Mosaic compile failure cannot take down a whole run.
     Warns when it defeats an explicit ``use_pallas=True`` — a forgotten
     export would otherwise turn the kernel equivalence tests into vacuous
-    staged-vs-staged comparisons."""
-    if not os.environ.get("GRACE_DISABLE_PALLAS"):
+    staged-vs-staged comparisons. Conventional false spellings ('', '0',
+    'false', 'no', 'off') mean NOT disabled."""
+    if os.environ.get("GRACE_DISABLE_PALLAS", "").strip().lower() in (
+            "", "0", "false", "no", "off"):
         return False
     if explicit:
         warnings.warn("GRACE_DISABLE_PALLAS is set: overriding explicit "

@@ -44,20 +44,32 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Per-block VMEM budget: grad + residual + residual-out f32 tiles are
-# (~main_rows, bc) each (Mosaic pads sublanes to 8); lane blocks must be
+# Per-block VMEM budget across ALL of a kernel's f32 block buffers (Mosaic
+# pads each buffer's sublane count to 8 and double-buffers; the 4 MiB
+# budget leaves that headroom within ~16 MiB VMEM); lane blocks must be
 # multiples of 128. If the budget cannot fit even bc=128 (tiny compress
-# ratios => many rows), block_cols returns 0 and the caller falls back to
-# the unfused XLA path instead of blowing VMEM.
+# ratios => many rows; huge worlds), the *_block_cols gate returns 0 and
+# callers fall back to the unfused XLA path instead of blowing VMEM.
 _VMEM_BUDGET = 4 * 2**20
 _MAX_BC = 2048
 
 
-def block_cols(main_rows: int) -> int:
-    rows_eff = -(-(main_rows + 1) // 8) * 8      # +1 tail row, sublane pad
-    bc = _VMEM_BUDGET // (3 * 4 * rows_eff)
-    bc = min(_MAX_BC, (bc // 128) * 128)
-    return bc                                     # 0 => does not fit
+def _block_cols(*buffer_rows: int) -> int:
+    units = sum(-(-r // 8) * 8 for r in buffer_rows)
+    bc = _VMEM_BUDGET // (4 * units)
+    return min(_MAX_BC, (bc // 128) * 128)        # 0 => does not fit
+
+
+def compress_block_cols(main_rows: int) -> int:
+    """bc for chunk_compress_feedback: grad/resid main+tail inputs, resid
+    main+tail outputs, two k-wide wire planes."""
+    return _block_cols(main_rows, main_rows, main_rows, 1, 1, 1, 1, 1)
+
+
+def aggregate_block_cols(main_rows: int, world: int) -> int:
+    """bc for chunk_aggregate_dense: (world, bc) vals+win inputs, main+tail
+    outputs — world-aware, a pod-scale W inflates the input blocks."""
+    return _block_cols(world, world, main_rows, 1)
 
 
 def _make_kernel(main_rows: int, has_resid: bool, beta: float, gamma: float,
@@ -122,11 +134,11 @@ def chunk_compress_feedback(flat: jax.Array, residual, k: int,
     n = flat.size
     main_rows = n // k                      # >= 2 by the caller's n >= 2k
     rem = n - main_rows * k
-    bc = block_cols(main_rows)
+    bc = compress_block_cols(main_rows)
     if bc <= 0:
         raise ValueError(
             f"chunk_compress_feedback: {main_rows} rows do not fit the VMEM "
-            "block budget — gate on ops.pallas_topk.block_cols() > 0")
+            "block budget — gate on compress_block_cols() > 0")
 
     def two_d(buf):
         main = buf[:main_rows * k].reshape(main_rows, k)   # free reshape
@@ -161,3 +173,75 @@ def chunk_compress_feedback(flat: jax.Array, residual, k: int,
     if rem:
         new_resid = jnp.concatenate([new_resid, resid_tail[0, :rem]])
     return vals.reshape(k), win.reshape(k), new_resid
+
+
+# ---------------------------------------------------------------------------
+# Exchange-side kernel: W gathered chunk payloads -> aggregated dense tensor
+# ---------------------------------------------------------------------------
+
+def _make_agg_kernel(main_rows: int, world: int, average: bool):
+    def kernel(vals_ref, win_ref, out_ref, tail_ref):
+        v = vals_ref[:].astype(jnp.float32)          # (world, bc)
+        w = win_ref[:]                               # (world, bc)
+        row_iota = jax.lax.broadcasted_iota(
+            jnp.int32, (main_rows, v.shape[1]), 0)
+        acc = jnp.zeros((main_rows, v.shape[1]), jnp.float32)
+        tail = jnp.zeros((1, v.shape[1]), jnp.float32)
+        for i in range(world):                       # static unroll, VPU adds
+            acc = acc + jnp.where(row_iota == w[i][None, :],
+                                  v[i][None, :], 0.0)
+            tail = tail + jnp.where((w[i] == main_rows)[None, :],
+                                    v[i][None, :], 0.0)
+        if average:
+            acc = acc / world
+            tail = tail / world
+        out_ref[:] = acc
+        tail_ref[:] = tail
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n", "average",
+                                             "interpret"))
+def chunk_aggregate_dense(vals: jax.Array, win: jax.Array, k: int, n: int,
+                          average: bool = True, interpret: bool = False
+                          ) -> jax.Array:
+    """Aggregate ``world`` gathered chunk payloads into one dense tensor.
+
+    ``vals``/``win`` are (world, k) stacks of wire values and winning-row
+    ids (flat index = win*k + column). The staged XLA path materializes
+    ``world`` one-hot dense buffers and sums them (~world+1 HBM passes over
+    n); this kernel reads the (world, k) wire planes once and writes the
+    summed (optionally world-averaged) dense tensor in a single n-sized
+    pass — the exchange-side twin of :func:`chunk_compress_feedback`.
+    A payload row may carry win == n//k (the tail row); out-of-range rows
+    beyond that cannot occur by the compress-side invariant.
+    """
+    main_rows = n // k
+    rem = n - main_rows * k
+    world = vals.shape[0]
+    bc = aggregate_block_cols(main_rows, world)
+    if bc <= 0:
+        raise ValueError(
+            f"chunk_aggregate_dense: {main_rows} rows x world={world} do "
+            "not fit the VMEM block budget — gate on "
+            "aggregate_block_cols() > 0")
+
+    wspec = pl.BlockSpec((world, bc), lambda j: (0, j),
+                         memory_space=pltpu.VMEM)
+    out_main, out_tail = pl.pallas_call(
+        _make_agg_kernel(main_rows, world, average),
+        grid=(pl.cdiv(k, bc),),
+        in_specs=[wspec, wspec],
+        out_specs=[pl.BlockSpec((main_rows, bc), lambda j: (0, j),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, bc), lambda j: (0, j),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((main_rows, k), jnp.float32),
+                   jax.ShapeDtypeStruct((1, k), jnp.float32)],
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(vals, win)
+    out = out_main.reshape(-1)
+    if rem:
+        out = jnp.concatenate([out, out_tail[0, :rem]])
+    return out

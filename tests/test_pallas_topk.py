@@ -120,8 +120,12 @@ def test_nan_column_keeps_indices_in_range():
 def test_vmem_overflow_ratio_falls_back():
     # ~10k rows at ratio 1e-4 cannot fit 128-lane f32 blocks in the VMEM
     # budget; the fused hook must decline rather than blow compilation.
-    from grace_tpu.ops.pallas_topk import block_cols
-    assert block_cols(10_000) == 0
+    from grace_tpu.ops.pallas_topk import (aggregate_block_cols,
+                                           compress_block_cols)
+    assert compress_block_cols(10_000) == 0
+    # pod-scale worlds inflate the aggregate kernel's input blocks
+    assert aggregate_block_cols(4, 65536) == 0
+    assert aggregate_block_cols(4, 8) >= 128
     comp = TopKCompressor(compress_ratio=1e-4, algorithm="chunk",
                           use_pallas=True)
     x = jnp.ones((200_000,), jnp.float32)
@@ -137,6 +141,45 @@ def test_bf16_buffer_falls_back_to_staged_path():
     st = jnp.zeros((1000,), jnp.bfloat16)
     assert comp.fused_feedback_compress(x, st, (1.0, 1.0),
                                         jax.random.key(0)) is None
+
+
+@pytest.mark.parametrize("world,n,ratio", [(1, 1000, 0.01), (4, 1003, 0.013),
+                                           (8, 4096, 0.25)])
+def test_aggregate_kernel_matches_staged_exchange(world, n, ratio):
+    """Exchange-side kernel == vmapped one-hot decompress + sum + average,
+    including colliding indices across ranks and the tail row."""
+    from grace_tpu.ops.pallas_topk import chunk_aggregate_dense
+
+    from grace_tpu.compressors.topk import static_k
+    comp = TopKCompressor(compress_ratio=ratio, algorithm="chunk",
+                          use_pallas=False)
+    k = static_k(n, ratio)
+    if n < 2 * k:
+        pytest.skip("degenerate")
+    xs = jax.random.normal(jax.random.key(0), (world, n), jnp.float32)
+    payloads = [comp.compress(xs[w], None, jax.random.key(1))[0]
+                for w in range(world)]
+    vals = jnp.stack([p[0] for p in payloads])
+    idx = jnp.stack([p[1] for p in payloads])
+    ctx = (n, (n,), jnp.float32)
+
+    staged = jnp.mean(jax.vmap(
+        lambda v, i: comp.decompress((v, i), ctx))(vals, idx), axis=0)
+    fused = chunk_aggregate_dense(vals, (idx // k).astype(jnp.int32), k, n,
+                                  average=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(staged),
+                               rtol=0, atol=1e-6)
+
+    hook = TopKCompressor(compress_ratio=ratio, algorithm="chunk",
+                          use_pallas=True)
+    out = hook.fused_aggregate_decompress((vals, idx), ctx, world)
+    if world == 1:
+        assert out is not None
+        np.testing.assert_allclose(np.asarray(out), np.asarray(staged),
+                                   rtol=0, atol=1e-6)
+    else:
+        # interpret mode declines multi-device worlds (deadlock guard)
+        assert out is None
 
 
 def test_non_chunk_and_tiny_k_fall_back():

@@ -55,7 +55,24 @@ def main() -> int:
     if not np.array_equal(new_resid, np.asarray(flat + resid) - dense):
         print("smoke: residual mismatch", file=sys.stderr)
         return 1
-    print("smoke: pallas chunk-topk kernel OK on", jax.devices()[0])
+    # Exchange-side kernel: W=8 gathered payloads vs the staged vmap path.
+    from grace_tpu.ops.pallas_topk import chunk_aggregate_dense
+    world = 8
+    xs = jax.random.normal(jax.random.key(3), (world, n), jnp.float32)
+    payloads = [ref.compress(xs[w], None, jax.random.key(4))[0]
+                for w in range(world)]
+    gvals = jnp.stack([p[0] for p in payloads])
+    gidx = jnp.stack([p[1] for p in payloads])
+    ctx = (n, (n,), jnp.float32)
+    staged = jnp.mean(jax.vmap(
+        lambda v, i: ref.decompress((v, i), ctx))(gvals, gidx), axis=0)
+    fused = chunk_aggregate_dense(gvals, (gidx // k).astype(jnp.int32), k, n,
+                                  average=True)
+    if not np.allclose(np.asarray(fused), np.asarray(staged), atol=1e-6):
+        print("smoke: aggregate kernel mismatch", file=sys.stderr)
+        return 1
+
+    print("smoke: pallas chunk-topk kernels OK on", jax.devices()[0])
     return 0
 
 
