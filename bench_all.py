@@ -26,35 +26,15 @@ import sys
 
 import bench
 
+# Ordered by evidence value: rows persist one by one (progressive_emit), so
+# if the flaky tunnel dies mid-sweep the completed prefix survives — put
+# the rows the analysis needs most right after the headline pair.
 CONFIGS = [
     # The headline pair (dense baseline first) comes verbatim from bench.py
     # so the two benchmarks can never drift apart.
     *bench.HEADLINE,
-    # Top-K selection variants (the headline uses 'chunk' — measured 1.02x
-    # dense on-chip vs approx 0.69x, TPU_VARIANTS.jsonl; exact top-k lowers
-    # to a full sort — the most expensive op in the pipeline; see
-    # compressors/topk.py):
-    {"name": "topk1pct_exact", "params": {"compressor": "topk",
-                                          "compress_ratio": 0.01,
-                                          "topk_algorithm": "exact",
-                                          "memory": "residual",
-                                          "communicator": "allgather",
-                                          "fusion": "flat"}},
-    {"name": "topk1pct_approx", "params": {"compressor": "topk",
-                                           "compress_ratio": 0.01,
-                                           "topk_algorithm": "approx",
-                                           "memory": "residual",
-                                           "communicator": "allgather",
-                                           "fusion": "flat"}},
-    {"name": "topk1pct_bf16", "params": {"compressor": "topk",
-                                         "compress_ratio": 0.01,
-                                         "topk_algorithm": "chunk",
-                                         "wire_dtype": "bfloat16",
-                                         "memory": "residual",
-                                         "communicator": "allgather",
-                                         "fusion": "flat"}},
-    # Ablation: chunk selection WITHOUT the fused Pallas local pipeline
-    # (ops/pallas_topk.py) — quantifies the kernel's on-chip win vs the
+    # Ablation: chunk selection WITHOUT the fused Pallas kernels
+    # (ops/pallas_topk.py) — quantifies the kernels' on-chip win vs the
     # staged XLA path (headline row runs with use_pallas='auto' = on).
     {"name": "topk1pct_nopallas", "params": {"compressor": "topk",
                                              "compress_ratio": 0.01,
@@ -63,6 +43,48 @@ CONFIGS = [
                                              "memory": "residual",
                                              "communicator": "allgather",
                                              "fusion": "flat"}},
+    # Two-shot scatter-reduce-recompress all-reduce: O(k) wire per rank vs
+    # allgather's O(W·k) (see comm.TwoShotAllreduce); VERDICT round-2
+    # item 5 asks for its on-chip stage-2 recompress overhead.
+    {"name": "topk1pct_twoshot", "params": {"compressor": "topk",
+                                            "compress_ratio": 0.01,
+                                            "topk_algorithm": "chunk",
+                                            "memory": "residual",
+                                            "communicator": "twoshot",
+                                            "fusion": "flat"}},
+    # Fixed-cost psum majority vote (~4n bf16 on the wire, W-independent):
+    # the pod-scale route for sign methods, next to the packed allgather
+    # row below (also VERDICT round-2 item 5).
+    {"name": "signsgd_vote", "params": {"compressor": "signsgd",
+                                        "memory": "none",
+                                        "communicator": "sign_allreduce",
+                                        "fusion": "flat"}},
+    {"name": "signsgd",    "params": {"compressor": "signsgd",
+                                      "memory": "none",
+                                      "communicator": "allgather",
+                                      "fusion": "flat"}},
+    {"name": "topk1pct_bf16", "params": {"compressor": "topk",
+                                         "compress_ratio": 0.01,
+                                         "topk_algorithm": "chunk",
+                                         "wire_dtype": "bfloat16",
+                                         "memory": "residual",
+                                         "communicator": "allgather",
+                                         "fusion": "flat"}},
+    # Top-K selection variants (the headline uses 'chunk'; exact top-k
+    # lowers to a full sort — the most expensive op in the pipeline; see
+    # compressors/topk.py):
+    {"name": "topk1pct_approx", "params": {"compressor": "topk",
+                                           "compress_ratio": 0.01,
+                                           "topk_algorithm": "approx",
+                                           "memory": "residual",
+                                           "communicator": "allgather",
+                                           "fusion": "flat"}},
+    {"name": "topk1pct_exact", "params": {"compressor": "topk",
+                                          "compress_ratio": 0.01,
+                                          "topk_algorithm": "exact",
+                                          "memory": "residual",
+                                          "communicator": "allgather",
+                                          "fusion": "flat"}},
     {"name": "qsgd",       "params": {"compressor": "qsgd",
                                       "quantum_num": 64,
                                       "memory": "none",
@@ -77,29 +99,10 @@ CONFIGS = [
                                        "memory": "powersgd",
                                        "communicator": "allreduce",
                                        "fusion": "none"}},
-    {"name": "signsgd",    "params": {"compressor": "signsgd",
-                                      "memory": "none",
-                                      "communicator": "allgather",
-                                      "fusion": "flat"}},
-    # Fixed-cost psum majority vote (~4n bf16 on the wire, W-independent):
-    # the pod-scale route for sign methods (VERDICT round-2 item 5 asks for
-    # its on-chip compute overhead next to the packed allgather row above).
-    {"name": "signsgd_vote", "params": {"compressor": "signsgd",
-                                        "memory": "none",
-                                        "communicator": "sign_allreduce",
-                                        "fusion": "flat"}},
     {"name": "onebit",     "params": {"compressor": "onebit",
                                       "memory": "residual",
                                       "communicator": "allgather",
                                       "fusion": "flat"}},
-    # Two-shot scatter-reduce-recompress all-reduce: O(k) wire per rank vs
-    # allgather's O(W·k) (see comm.TwoShotAllreduce).
-    {"name": "topk1pct_twoshot", "params": {"compressor": "topk",
-                                            "compress_ratio": 0.01,
-                                            "topk_algorithm": "chunk",
-                                            "memory": "residual",
-                                            "communicator": "twoshot",
-                                            "fusion": "flat"}},
     # Fusion ablation (headline pair unfused, and Horovod's default 64 MiB
     # bucketing — SURVEY.md §2.4):
     {"name": "none_unfused", "params": {"compressor": "none",

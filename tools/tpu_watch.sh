@@ -12,6 +12,21 @@ cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watch.log
 BENCH_ATTEMPTS=0
 ORIG_GDP="${GRACE_DISABLE_PALLAS:-}"
+# Single instance via flock (manage with: kill "$(cat /tmp/tpu_watch.pid)").
+# pkill -f tpu_watch matches the *caller's own shell* when the launch
+# command line contains the script path — that footgun killed two watcher
+# restarts in a row. The lock (held for the process lifetime) is atomic —
+# no check-then-write race between two near-simultaneous launches, and no
+# stale-PID ambiguity after a SIGKILL: the kernel drops the lock with the
+# process.
+PIDFILE=/tmp/tpu_watch.pid
+exec 9>"$PIDFILE.lock"
+if ! flock -n 9; then
+  echo "=== $(date -u +%FT%TZ) another watcher holds the lock — exiting" \
+       >> "$LOG"
+  exit 0
+fi
+echo $$ > "$PIDFILE"
 
 # The host has one core: pause any long-running CPU-mesh training
 # (tools/cifar_runs.sh) for the duration of a TPU measurement so host
@@ -37,7 +52,7 @@ resume_cpu_jobs() {
   pgid=$(cifar_pgid) && kill -CONT -"$pgid" 2>/dev/null \
     && echo "=== resumed cifar_runs" >> "$LOG"
 }
-trap resume_cpu_jobs EXIT
+trap 'resume_cpu_jobs; rm -f "$PIDFILE"' EXIT
 MAX_BENCH_ATTEMPTS=5   # cap: a deterministic bench bug must not re-burn the
                        # shared chip for hours per loop iteration forever
 while true; do
