@@ -8,6 +8,15 @@
 #
 # Usage: setsid nohup tools/cifar_runs.sh & (log: cifar_runs.log at repo root)
 cd "$(dirname "$0")/.." || exit 1
+# Single instance via flock: two concurrent runs contend on the one-core
+# host AND fight over the pgid file, leaving one of them unpausable by
+# tpu_watch.sh (observed as interleaved epoch rows in cifar_runs.log).
+exec 9>/tmp/cifar_runs.lock
+if ! flock -n 9; then
+  echo "=== $(date -u +%FT%TZ) another cifar_runs is alive — exiting" \
+       >> cifar_runs.log
+  exit 0
+fi
 echo $$ > /tmp/cifar_runs.pgid
 # Abnormal exit must not leave a stale pgid for tpu_watch.sh to SIGSTOP
 # after the kernel recycles it for an unrelated process group.

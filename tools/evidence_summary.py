@@ -1,0 +1,120 @@
+"""Render the repo's benchmark evidence files as one markdown summary.
+
+Reads (all repo-root, all optional — missing files are skipped):
+  BENCH_TPU_LAST.json      headline dense-vs-compressed pair (TPU)
+  BENCH_ALL_TPU_LAST.json  per-algorithm TPU sweep
+  BENCH_ALL_CPU.json       per-algorithm CPU-mesh smoke sweep
+  TPU_VARIANTS.jsonl       selection-variant session rows
+
+Usage: python tools/evidence_summary.py [--update-readme]
+Prints markdown to stdout; --update-readme splices it between the
+<!-- evidence:begin --> / <!-- evidence:end --> markers in README.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BEGIN, END = "<!-- evidence:begin -->", "<!-- evidence:end -->"
+
+
+def _load(name):
+    """Load a .json dict or a JSON-Lines row list (BENCH_ALL_CPU.json is
+    JSONL despite its extension; rows whose only key is _meta are
+    metadata, not data)."""
+    try:
+        with open(os.path.join(ROOT, name)) as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        rows = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                return None
+        return rows or None
+
+
+def _fmt(x, nd=2):
+    return "—" if x is None else f"{x:.{nd}f}"
+
+
+def _row_table(rows, title):
+    out = [f"**{title}**", "",
+           "| config | imgs/sec | vs dense | wire ratio | MFU |",
+           "|---|---|---|---|---|"]
+    for r in rows:
+        flags = " ⚠staged" if r.get("env_pallas_disabled") else ""
+        out.append(
+            f"| {r.get('config')}{flags} | {_fmt(r.get('imgs_per_sec'))} | "
+            f"{_fmt(r.get('vs_baseline'), 4)} | "
+            f"{_fmt(r.get('wire_ratio'), 4)} | {_fmt(r.get('mfu'), 4)} |")
+    return out
+
+
+def build() -> str:
+    parts = []
+    head = _load("BENCH_TPU_LAST.json")
+    if head and head.get("rows"):
+        cap = head.get("captured_at", "?")
+        chip = head.get("chip", "?")
+        partial = " (PARTIAL)" if head.get("partial") else ""
+        parts += _row_table(
+            head["rows"],
+            f"TPU headline ({chip}, captured {cap}){partial}")
+        parts.append("")
+    sweep = _load("BENCH_ALL_TPU_LAST.json")
+    if sweep and sweep.get("rows"):
+        cap = sweep.get("captured_at", "?")
+        partial = " (PARTIAL)" if sweep.get("partial") else ""
+        parts += _row_table(
+            sweep["rows"], f"TPU per-algorithm sweep (captured {cap})"
+            + partial)
+        parts.append("")
+    variants = _load("TPU_VARIANTS.jsonl")
+    if variants:
+        parts += _row_table(variants, "Top-K selection variants (TPU)")
+        parts.append("")
+    cpu = _load("BENCH_ALL_CPU.json")
+    if isinstance(cpu, list):
+        data_rows = [r for r in cpu if r.get("config")]
+        if data_rows:
+            parts.append(
+                f"CPU-mesh smoke sweep: {len(data_rows)} configs in "
+                "`BENCH_ALL_CPU.json` (throughput ratios are host-bound "
+                "artifacts; the wire columns are the content).")
+    return "\n".join(parts).rstrip() + "\n"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update-readme", action="store_true")
+    args = ap.parse_args()
+    md = build()
+    if not args.update_readme:
+        print(md, end="")
+        return
+    path = os.path.join(ROOT, "README.md")
+    with open(path) as f:
+        text = f.read()
+    if BEGIN not in text or END not in text:
+        raise SystemExit(f"README.md lacks {BEGIN} / {END} markers")
+    pre = text.split(BEGIN)[0]
+    post = text.split(END)[1]
+    with open(path, "w") as f:
+        f.write(pre + BEGIN + "\n" + md + END + post)
+    print("README.md updated")
+
+
+if __name__ == "__main__":
+    main()

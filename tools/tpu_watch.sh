@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watch.log
 BENCH_ATTEMPTS=0
 ORIG_GDP="${GRACE_DISABLE_PALLAS:-}"
-# Single instance via flock (manage with: kill "$(cat /tmp/tpu_watch.pid)").
+# Single instance via flock (stop with: tools/tpu_watch.sh stop).
 # pkill -f tpu_watch matches the *caller's own shell* when the launch
 # command line contains the script path — that footgun killed two watcher
 # restarts in a row. The lock (held for the process lifetime) is atomic —
@@ -20,6 +20,18 @@ ORIG_GDP="${GRACE_DISABLE_PALLAS:-}"
 # stale-PID ambiguity after a SIGKILL: the kernel drops the lock with the
 # process.
 PIDFILE=/tmp/tpu_watch.pid
+if [ "${1:-}" = "stop" ]; then
+  # Identity-checked stop: never signal a recycled PID, and TERM (not
+  # KILL) so the trap kills the in-flight bench child and resumes any
+  # paused CPU jobs instead of leaving an orphan burning the chip.
+  pid=$(cat "$PIDFILE" 2>/dev/null) || { echo "no pidfile"; exit 1; }
+  if grep -qa "tools/tpu_watch.sh" "/proc/$pid/cmdline" 2>/dev/null; then
+    kill "$pid" && echo "stopped watcher $pid"
+  else
+    echo "pid $pid is not a watcher (stale pidfile?)"; exit 1
+  fi
+  exit 0
+fi
 exec 9>"$PIDFILE.lock"
 if ! flock -n 9; then
   echo "=== $(date -u +%FT%TZ) another watcher holds the lock — exiting" \
@@ -27,6 +39,22 @@ if ! flock -n 9; then
   exit 0
 fi
 echo $$ > "$PIDFILE"
+# Kill the in-flight measurement child on TERM/INT so stopping the watcher
+# cannot orphan a bench run that keeps the chip busy while the EXIT trap
+# resumes CPU jobs into contention with it.
+CHILD=
+on_term() { [ -n "$CHILD" ] && kill "$CHILD" 2>/dev/null; exit 143; }
+trap on_term TERM INT
+run_py() {  # run_py <timeout> <args...>: killable python step
+  # 9>&- : children must NOT inherit the flock fd — an orphaned probe
+  # once held the lock after its watcher died and blocked every restart.
+  timeout "$@" >> "$LOG" 2>&1 9>&- &
+  CHILD=$!
+  wait "$CHILD"
+  local rc=$?
+  CHILD=
+  return $rc
+}
 
 # The host has one core: pause any long-running CPU-mesh training
 # (tools/cifar_runs.sh) for the duration of a TPU measurement so host
@@ -52,14 +80,16 @@ resume_cpu_jobs() {
   pgid=$(cifar_pgid) && kill -CONT -"$pgid" 2>/dev/null \
     && echo "=== resumed cifar_runs" >> "$LOG"
 }
-trap 'resume_cpu_jobs; rm -f "$PIDFILE"' EXIT
+# Remove the pidfile only if it is still OURS: a dying watcher's trap must
+# not delete the pidfile a just-started successor wrote (observed race).
+trap 'resume_cpu_jobs;
+      [ "$(cat "$PIDFILE" 2>/dev/null)" = "$$" ] && rm -f "$PIDFILE"' EXIT
 MAX_BENCH_ATTEMPTS=5   # cap: a deterministic bench bug must not re-burn the
                        # shared chip for hours per loop iteration forever
 while true; do
   echo "=== $(date -u +%FT%TZ) probing" >> "$LOG"
-  if timeout 300 python -c \
-      "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" \
-      >> "$LOG" 2>&1; then
+  if run_py 300 python -c \
+      "import jax; d=jax.devices(); assert d[0].platform=='tpu', d"; then
     BENCH_ATTEMPTS=$((BENCH_ATTEMPTS + 1))
     echo "=== $(date -u +%FT%TZ) tunnel ALIVE — headline bench" \
          "(attempt $BENCH_ATTEMPTS/$MAX_BENCH_ATTEMPTS)" >> "$LOG"
@@ -70,7 +100,7 @@ while true; do
     # kernel. An operator-set GRACE_DISABLE_PALLAS from the launch
     # environment is preserved either way (ORIG_GDP).
     pause_cpu_jobs
-    if timeout 420 python tools/pallas_smoke.py >> "$LOG" 2>&1; then
+    if run_py 420 python tools/pallas_smoke.py; then
       if [ -n "$ORIG_GDP" ]; then
         export GRACE_DISABLE_PALLAS="$ORIG_GDP"
       else
@@ -81,7 +111,7 @@ while true; do
       echo "=== $(date -u +%FT%TZ) pallas smoke FAILED — benching with" \
            "GRACE_DISABLE_PALLAS=1" >> "$LOG"
     fi
-    timeout 1800 python bench.py --_worker tpu >> "$LOG" 2>&1
+    run_py 1800 python bench.py --_worker tpu
     rc1=$?
     echo "=== headline rc=$rc1" >> "$LOG"
     rc2=1
@@ -89,7 +119,7 @@ while true; do
       # Headline failure usually means the tunnel died again — skip the
       # 2.5h sweep in that case and go straight back to probing.
       echo "=== $(date -u +%FT%TZ) per-algorithm sweep" >> "$LOG"
-      timeout 9000 python bench_all.py --_worker tpu >> "$LOG" 2>&1
+      run_py 9000 python bench_all.py --_worker tpu
       rc2=$?
       echo "=== sweep rc=$rc2" >> "$LOG"
     fi
