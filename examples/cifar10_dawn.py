@@ -153,9 +153,12 @@ def main():
                "total time": timer.total_time}
         table.append(row)
         tsv.append(row)
+        if jax.process_index() == 0:
+            # Rewrite after every epoch: a 24-epoch run on the CPU mesh is
+            # hours long, and a killed run must still leave its curve.
+            tsv.write(args.tsv)
 
     if jax.process_index() == 0:
-        tsv.write(args.tsv)
         rank_zero_print(f"TSV log -> {args.tsv}")
 
 

@@ -29,9 +29,19 @@ LOG=cifar_runs.log
 # init, rc=1, zero epochs).
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS=--xla_force_host_platform_device_count=8
+# Real CIFAR-10 binaries are not available in this offline environment, so
+# these 24-epoch runs exercise the full DAWNBench recipe on the synthetic
+# default (VERDICT round-2 item 4's documented caveat): they are recipe/
+# stability evidence, not 94%-accuracy evidence. Pass --data-dir through
+# CIFAR_DATA_DIR if real data ever lands.
+DATA_ARGS=()
+[ -n "${CIFAR_DATA_DIR:-}" ] && DATA_ARGS=(--data-dir "$CIFAR_DATA_DIR")
 run() {
   echo "=== $(date -u +%FT%TZ) $*" >> "$LOG"
-  python examples/cifar10_dawn.py --epochs 24 "$@" >> "$LOG" 2>&1
+  # 9>&- : children must not inherit the flock fd (an orphaned trainer
+  # would hold the lock for hours and block restarts).
+  python examples/cifar10_dawn.py --epochs 24 ${DATA_ARGS[@]+"${DATA_ARGS[@]}"} \
+    "$@" >> "$LOG" 2>&1 9>&-
   echo "=== rc=$?" >> "$LOG"
 }
 run --tsv examples/logs/cifar10_dawn_24ep.tsv

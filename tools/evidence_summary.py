@@ -53,6 +53,7 @@ def _row_table(rows, title):
     out = [f"**{title}**", "",
            "| config | imgs/sec | vs dense | wire ratio | MFU |",
            "|---|---|---|---|---|"]
+    rows = [r for r in rows if r.get("config")]   # skip _meta-style rows
     for r in rows:
         flags = " ⚠staged" if r.get("env_pallas_disabled") else ""
         out.append(

@@ -142,5 +142,6 @@ while true; do
   else
     echo "=== $(date -u +%FT%TZ) tunnel dead, sleeping 600s" >> "$LOG"
   fi
-  sleep 600
+  sleep 600 &     # background + wait: the TERM trap fires immediately
+  wait $!         # instead of after up to 10 min of foreground sleep
 done
