@@ -137,11 +137,11 @@ while true; do
            "stopping with partial evidence" >> "$LOG"
       break
     fi
-    echo "=== $(date -u +%FT%TZ) bench(es) failed, sleeping 600s before" \
+    echo "=== $(date -u +%FT%TZ) bench(es) failed, sleeping 240s before" \
          "re-probe" >> "$LOG"
   else
-    echo "=== $(date -u +%FT%TZ) tunnel dead, sleeping 600s" >> "$LOG"
+    echo "=== $(date -u +%FT%TZ) tunnel dead, sleeping 240s" >> "$LOG"
   fi
-  sleep 600 &     # background + wait: the TERM trap fires immediately
+  sleep 240 &     # background + wait: the TERM trap fires immediately
   wait $!         # instead of after up to 10 min of foreground sleep
 done
