@@ -142,6 +142,6 @@ while true; do
   else
     echo "=== $(date -u +%FT%TZ) tunnel dead, sleeping 240s" >> "$LOG"
   fi
-  sleep 240 &     # background + wait: the TERM trap fires immediately
+  sleep 240 9>&- &  # background + wait: the TERM trap fires immediately
   wait $!         # instead of after up to 10 min of foreground sleep
 done
