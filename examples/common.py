@@ -82,7 +82,7 @@ def grace_params_from_args(args) -> dict:
         fusion = None
     elif fusion != "flat":
         fusion = int(fusion)
-    return {
+    params = {
         "compressor": args.compressor,
         "memory": args.memory,
         "communicator": args.communicator,
@@ -94,9 +94,14 @@ def grace_params_from_args(args) -> dict:
         "fusion": fusion,
         "topk_algorithm": args.topk_algorithm,
         "recall_target": args.recall_target,
-        "use_pallas": {"auto": "auto", "on": True,
-                       "off": False}[args.use_pallas],
     }
+    # Only force use_pallas when the operator explicitly asked: the flag's
+    # resting default must leave each compressor's own default in charge
+    # (TopK defaults to 'auto'; QSGD stays off until its kernel has on-chip
+    # evidence — flipping it from a CLI default would bypass that gate).
+    if args.use_pallas != "auto":
+        params["use_pallas"] = args.use_pallas == "on"
+    return params
 
 
 # ---------------------------------------------------------------------------

@@ -49,17 +49,26 @@ def _fmt(x, nd=2):
     return "—" if x is None else f"{x:.{nd}f}"
 
 
-def _row_table(rows, title):
+def _row_table(rows, title, value_key="imgs_per_sec",
+               value_head="imgs/sec"):
+    spread = any(r.get("spread_pct") is not None for r in rows)
+    shead = " spread% |" if spread else ""
     out = [f"**{title}**", "",
-           "| config | imgs/sec | vs dense | wire ratio | MFU |",
-           "|---|---|---|---|---|"]
+           f"| config | {value_head} | vs dense | wire ratio | MFU |{shead}",
+           "|---|---|---|---|---|" + ("---|" if spread else "")]
     rows = [r for r in rows if r.get("config")]   # skip _meta-style rows
     for r in rows:
         flags = " ⚠staged" if r.get("env_pallas_disabled") else ""
+        if r.get("error"):
+            out.append(f"| {r.get('config')} | ERROR: {r['error'][:60]} |"
+                       + " — |" * (3 + spread))
+            continue
+        scell = f" {_fmt(r.get('spread_pct'), 1)} |" if spread else ""
         out.append(
-            f"| {r.get('config')}{flags} | {_fmt(r.get('imgs_per_sec'))} | "
+            f"| {r.get('config')}{flags} | {_fmt(r.get(value_key))} | "
             f"{_fmt(r.get('vs_baseline'), 4)} | "
-            f"{_fmt(r.get('wire_ratio'), 4)} | {_fmt(r.get('mfu'), 4)} |")
+            f"{_fmt(r.get('wire_ratio'), 4)} | {_fmt(r.get('mfu'), 4)} |"
+            + scell)
     return out
 
 
@@ -85,6 +94,29 @@ def build() -> str:
     variants = _load("TPU_VARIANTS.jsonl")
     if variants:
         parts += _row_table(variants, "Top-K selection variants (TPU)")
+        parts.append("")
+    bert = _load("BENCH_BERT_TPU_LAST.json")
+    if bert and bert.get("rows"):
+        cap = bert.get("captured_at", "?")
+        partial = " (PARTIAL)" if bert.get("partial") else ""
+        parts += _row_table(
+            bert["rows"], f"BERT-base + PowerSGD r4 (captured {cap})"
+            + partial, value_key="tokens_per_sec", value_head="tokens/sec")
+        parts.append("")
+    rec = _load("BENCH_TPU_LAST.json") or {}
+    proj = next((r["projection"] for r in rec.get("rows", [])
+                 if r.get("config") == "topk1pct" and r.get("projection")),
+                None)
+    if proj:
+        parts += ["**Projected multi-chip speedup vs dense (topk1pct, "
+                  "analytic wire model over measured single-chip step)**", "",
+                  "| world | recv bytes/rank | step ms (ICI) | speedup ICI "
+                  "| speedup DCN |", "|---|---|---|---|---|"]
+        for p in proj:
+            parts.append(f"| {p['world']} | {p['recv_bytes_per_rank']:,} | "
+                         f"{p['step_ms_ici']} | "
+                         f"{p['speedup_vs_dense_ici']} | "
+                         f"{p['speedup_vs_dense_dcn']} |")
         parts.append("")
     cpu = _load("BENCH_ALL_CPU.json")
     if isinstance(cpu, list):

@@ -72,7 +72,29 @@ def main() -> int:
         print("smoke: aggregate kernel mismatch", file=sys.stderr)
         return 1
 
-    print("smoke: pallas chunk-topk kernels OK on", jax.devices()[0])
+    # QSGD quant kernel (ops/pallas_quant.py): the watcher's
+    # degrade-to-staged hatch must also cover a Mosaic failure here, since
+    # the sweep's qsgd_pallas row enables it. Bit-exact comparison against
+    # the staged path is impossible (different PRNG bit source), so check
+    # the deterministic invariants of stochastic rounding instead: every
+    # |level| within the floor/ceil envelope of |x|·q/||x||, sign folded.
+    from grace_tpu.ops.pallas_quant import quantize_stochastic
+    q = 64
+    norm = jnp.linalg.norm(flat)
+    levels = np.asarray(quantize_stochastic(flat, norm, jnp.int32(7), q)
+                        ).astype(np.float64)
+    lf = np.abs(np.asarray(flat, np.float64)) * (q / float(norm))
+    mag = np.abs(levels)
+    if not ((mag >= np.floor(lf) - 1e-6) & (mag <= np.ceil(lf) + 1e-6)).all():
+        print("smoke: qsgd level outside floor/ceil envelope",
+              file=sys.stderr)
+        return 1
+    if (np.sign(levels) * np.sign(np.asarray(flat)) < 0).any():
+        print("smoke: qsgd sign mismatch", file=sys.stderr)
+        return 1
+
+    print("smoke: pallas chunk-topk + qsgd-quant kernels OK on",
+          jax.devices()[0])
     return 0
 
 

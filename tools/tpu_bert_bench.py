@@ -1,0 +1,171 @@
+"""On-chip BERT-base + PowerSGD rank-4 bench row (VERDICT round-3 item 7).
+
+BASELINE.json config 4 pairs "BERT-base SQuAD" with PowerSGD rank-4 over
+allreduce (reference grace_dl/dist/compressor/powersgd.py); the convergence
+example is examples/bert_powersgd.py, but no perf row existed. This measures
+the dense baseline and powersgd_r4 interleaved in ONE session — the same
+same-session discipline as bench.bench_configs — reporting tokens/sec,
+spread, and PowerSGD's analytic wire bytes (compressors/powersgd.py
+wire_nbytes). Rows persist row-by-row to BENCH_BERT_TPU_LAST.json
+(bench.progressive_emit), so a mid-run tunnel death keeps the dense row.
+
+Run by tools/tpu_watch.sh after the main sweep; manual:
+    python tools/tpu_bert_bench.py --platform tpu    # on the chip
+    python tools/tpu_bert_bench.py --platform cpu    # tiny-model smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+EVIDENCE_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_BERT_TPU_LAST.json")
+
+CONFIGS = [
+    {"name": "bert_dense", "params": {"compressor": "none", "memory": "none",
+                                      "communicator": "allreduce",
+                                      "fusion": "flat"}},
+    {"name": "bert_powersgd_r4", "params": {"compressor": "powersgd",
+                                            "compress_rank": 4,
+                                            "memory": "powersgd",
+                                            "communicator": "allreduce",
+                                            "fusion": "none"}},
+]
+
+
+def run(platform: str, emit) -> None:
+    devices = bench.setup_platform(platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from grace_tpu import grace_from_params
+    from grace_tpu.models import layers as L
+    from grace_tpu.models import transformer
+    from grace_tpu.parallel import batch_sharded, data_parallel_mesh
+    from grace_tpu.train import (init_stateful_train_state,
+                                 make_stateful_train_step)
+    from grace_tpu.utils import wire_report
+
+    on_tpu = devices[0].platform == "tpu"
+    mesh = data_parallel_mesh(devices)
+    # BERT-base at the standard SQuAD fine-tuning length on the chip; a tiny
+    # encoder on the CPU mesh so the smoke finishes on a one-core host.
+    seq = 384 if on_tpu else 64
+    per_device_bs = 8 if on_tpu else 2
+    cfg = (transformer.base(num_classes=2, max_len=seq) if on_tpu
+           else transformer.tiny(num_classes=2, max_len=seq))
+    repeats = 3 if on_tpu else 1
+    # Window >= ~1.3 s against tunnel RTT jitter (memory: timed windows
+    # must dwarf the ~65-400 ms fetch RTT): BERT-base steps are ~10x a
+    # ResNet bs=32 step, so fewer batches suffice.
+    n_batches = 40 if on_tpu else 2
+
+    n = per_device_bs * len(devices)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (n, seq)), jnp.int32)
+    spans = jnp.asarray(
+        np.stack([rng.integers(0, seq // 2, n),
+                  rng.integers(seq // 2, seq, n)], 1), jnp.int32)
+    batch = jax.device_put((ids, spans), batch_sharded(mesh))
+
+    def build(grace_params):
+        grace = grace_from_params(grace_params)
+        optimizer = optax.chain(grace.transform(seed=0), optax.adamw(5e-5))
+
+        def loss_fn(params, mstate, b):
+            idb, spanb = b
+            x = transformer.encode(params, idb, cfg, dtype=jnp.bfloat16)
+            logits = L.dense_apply(params["cls"], x.astype(jnp.float32))
+            loss = (optax.softmax_cross_entropy_with_integer_labels(
+                        logits[..., 0], spanb[:, 0])
+                    + optax.softmax_cross_entropy_with_integer_labels(
+                        logits[..., 1], spanb[:, 1]))
+            return loss.mean(), mstate
+
+        step = make_stateful_train_step(loss_fn, optimizer, mesh)
+        params, mstate = transformer.init(jax.random.key(0), cfg)
+        ts = init_stateful_train_state(params, mstate, optimizer, mesh)
+        return step, ts, grace, params
+
+    chip = getattr(devices[0], "device_kind", devices[0].platform)
+    print(f"[bert-bench] mesh: {len(devices)}x {devices[0].platform} "
+          f"({chip}), seq={seq}, bs={per_device_bs}/device",
+          file=sys.stderr, flush=True)
+
+    base_step, base_ts, base_grace, base_params = build(CONFIGS[0]["params"])
+    comp_step, comp_ts, comp_grace, comp_params = build(CONFIGS[1]["params"])
+
+    bsamples, csamples = [], []
+    for r in range(repeats):
+        warm = 4 if r == 0 else 2
+        s, base_ts = bench.throughput(base_step, base_ts, batch, n_batches,
+                                      warmup=warm)
+        bsamples.append(s)
+        s, comp_ts = bench.throughput(comp_step, comp_ts, batch, n_batches,
+                                      warmup=warm)
+        csamples.append(s)
+
+    med = statistics.median
+    n_elems = sum(x.size for x in jax.tree_util.tree_leaves(base_params))
+    for name, samples, other, grace, params in (
+            ("bert_dense", bsamples, bsamples, base_grace, base_params),
+            ("bert_powersgd_r4", csamples, bsamples, comp_grace,
+             comp_params)):
+        seqs = med(samples)
+        rep = wire_report(grace.compressor, params)
+        spread = (100.0 * (max(samples) - min(samples)) / seqs
+                  if seqs else 0.0)
+        vote = getattr(grace.compressor, "vote_aggregate", False)
+        emit({
+            "config": name,
+            "tokens_per_sec": round(seqs * seq, 1),
+            "seqs_per_sec": round(seqs, 2),
+            "samples_seqs_per_sec": [round(s, 2) for s in samples],
+            "spread_pct": round(spread, 2),
+            "vs_baseline": round(seqs / med(other), 4),
+            "same_session": True,
+            "seq_len": seq,
+            "per_device_bs": per_device_bs,
+            "model": "bert-base" if on_tpu else "bert-tiny(smoke)",
+            "n_params": n_elems,
+            "wire_bytes_per_step": rep.wire_bytes,
+            "wire_ratio": round(rep.ratio, 6),
+            "wire_recv_bytes_per_step": bench.recv_bytes_model(
+                grace.communicator, vote, rep.wire_bytes, n_elems,
+                len(devices)),
+            "projection": bench.project_multichip(
+                n / seqs, n / med(bsamples), grace, rep.wire_bytes,
+                rep.dense_bytes, n_elems),
+            "platform": devices[0].platform,
+            "n_devices": len(devices),
+            "chip": chip,
+        })
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--platform", default="tpu", choices=["tpu", "cpu"])
+    args = ap.parse_args()
+    emit = bench.progressive_emit(
+        lambda r: print(json.dumps(r), flush=True),
+        n_expected=len(CONFIGS),
+        evidence_path=EVIDENCE_PATH,
+        metric="bert_powersgd_r4_tokens_per_sec",
+        headline_config="bert_powersgd_r4",
+        value_key="tokens_per_sec")
+    run(args.platform, emit)
+
+
+if __name__ == "__main__":
+    main()

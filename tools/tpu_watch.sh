@@ -115,19 +115,26 @@ while true; do
     rc1=$?
     echo "=== headline rc=$rc1" >> "$LOG"
     rc2=1
+    rc3=1
     if [ "$rc1" -eq 0 ]; then
       # Headline failure usually means the tunnel died again — skip the
       # 2.5h sweep in that case and go straight back to probing.
       echo "=== $(date -u +%FT%TZ) per-algorithm sweep" >> "$LOG"
-      run_py 9000 python bench_all.py --_worker tpu
+      # 12000s: the sweep grew the bs-sweep + qsgd_pallas rows (round 4)
+      # and each row now brackets itself with interleaved dense samples.
+      run_py 12000 python bench_all.py --_worker tpu
       rc2=$?
       echo "=== sweep rc=$rc2" >> "$LOG"
+      echo "=== $(date -u +%FT%TZ) bert/powersgd bench" >> "$LOG"
+      run_py 3600 python tools/tpu_bert_bench.py --platform tpu
+      rc3=$?
+      echo "=== bert rc=$rc3" >> "$LOG"
     fi
     resume_cpu_jobs
-    # Only retire the watcher once BOTH measurements actually landed —
+    # Only retire the watcher once ALL measurements actually landed —
     # a tunnel that dies mid-bench must put us back into the probe loop
     # (partial rows are already persisted by the workers either way).
-    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ]; then
+    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ]; then
       echo "=== $(date -u +%FT%TZ) both benches complete — watcher done" \
         >> "$LOG"
       break
