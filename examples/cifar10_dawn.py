@@ -27,7 +27,8 @@ from grace_tpu.parallel import (batch_sharded, data_parallel_mesh,
                                 initialize_distributed)
 from grace_tpu.train import (init_stateful_train_state, make_eval_step,
                              make_stateful_train_step)
-from grace_tpu.utils import (TableLogger, Timer, TSVLogger, rank_zero_print)
+from grace_tpu.utils import (TableLogger, Timer, TSVLogger, rank_zero_print,
+                             run_provenance)
 
 
 
@@ -123,7 +124,16 @@ def main():
     ts = init_stateful_train_state(params, mstate, optimizer, mesh)
 
     aug_rng = np.random.default_rng(args.seed)
-    table, tsv = TableLogger(), TSVLogger()
+    # The TSV is an evidence file: it must say on its face whether it
+    # trained on real CIFAR-10 (the 94%/24-epoch DAWNBench claim) or the
+    # synthetic plumbing-check default, and on what platform.
+    prov = run_provenance(
+        data=f"real:{args.data_dir}" if args.data_dir else "synthetic",
+        recipe="cifar10_dawn 24-epoch DAWNBench",
+        compressor=args.compressor, memory=args.memory,
+        communicator=args.communicator, epochs=args.epochs,
+        batch_size=args.batch_size)
+    table, tsv = TableLogger(), TSVLogger(provenance=prov)
     timer = Timer()
     for epoch in range(1, args.epochs + 1):
         xs = x_train if args.no_augment else augment(x_train, aug_rng)

@@ -32,7 +32,8 @@ from grace_tpu.models import lenet
 from grace_tpu.parallel import batch_sharded, data_parallel_mesh
 from grace_tpu.train import (init_stateful_train_state, make_eval_step,
                              make_stateful_train_step)
-from grace_tpu.utils import TableLogger, Timer, rank_zero_print, wire_report
+from grace_tpu.utils import (TableLogger, Timer, rank_zero_print,
+                             run_provenance, wire_report)
 
 
 
@@ -99,8 +100,13 @@ def run(argv=None):
 
     if args.tsv:
         os.makedirs(os.path.dirname(args.tsv) or ".", exist_ok=True)
+        # Self-describing evidence: data source + platform in the file.
+        prov = run_provenance(data="real:sklearn-uci-digits", compressor=args.compressor,
+                              memory=args.memory,
+                              communicator=args.communicator)
         with open(args.tsv, "w") as f:
-            f.write("\n".join(rows) + "\n")
+            f.write("\n".join([f"# {k}: {v}" for k, v in prov.items()]
+                              + rows) + "\n")
         rank_zero_print(f"log -> {args.tsv}")
     return test_acc
 

@@ -11,14 +11,17 @@ per-algorithm bytes-on-wire / compression-ratio accounting (`wire_report`).
 """
 
 from grace_tpu.utils.logging import (TableLogger, Timer, TSVLogger, localtime,
-                                     rank_zero_only, rank_zero_print)
+                                     rank_zero_only, rank_zero_print,
+                                     run_provenance)
 from grace_tpu.utils.metrics import (CompressionReport, LeafReport,
-                                     payload_nbytes, wire_report)
+                                     debug_nan_residuals, payload_nbytes,
+                                     wire_report)
 from grace_tpu.utils.profiling import StepTimer, trace
 
 __all__ = [
     "TableLogger", "TSVLogger", "Timer", "localtime",
-    "rank_zero_only", "rank_zero_print",
-    "CompressionReport", "LeafReport", "payload_nbytes", "wire_report",
+    "rank_zero_only", "rank_zero_print", "run_provenance",
+    "CompressionReport", "LeafReport", "debug_nan_residuals",
+    "payload_nbytes", "wire_report",
     "StepTimer", "trace",
 ]

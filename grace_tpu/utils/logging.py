@@ -24,7 +24,7 @@ from typing import Callable, Mapping, Optional, Sequence, TextIO
 import jax
 
 __all__ = ["Timer", "TableLogger", "TSVLogger", "localtime",
-           "rank_zero_only", "rank_zero_print"]
+           "rank_zero_only", "rank_zero_print", "run_provenance"]
 
 
 def localtime() -> str:
@@ -104,11 +104,18 @@ class TSVLogger:
 
     ``append`` takes the same row dict as :class:`TableLogger` with keys
     ``epoch``, ``total time`` (seconds), ``test acc`` (fraction in [0,1]).
+
+    ``provenance`` entries are written as leading ``# key: value`` comment
+    lines. Evidence files must carry their own provenance (VERDICT round-3
+    item 3: a synthetic-data curve was mistaken for the real benchmark):
+    at minimum pass ``data`` (``synthetic`` | ``real`` + source) and
+    ``platform``; :func:`run_provenance` assembles the standard set.
     """
 
     HEADER = "epoch\thours\ttop1Accuracy"
 
-    def __init__(self):
+    def __init__(self, provenance: Optional[Mapping[str, object]] = None):
+        self._prov = dict(provenance or {})
         self._rows = [self.HEADER]
 
     def append(self, row: Mapping[str, object]) -> None:
@@ -118,8 +125,28 @@ class TSVLogger:
         self._rows.append(f"{epoch}\t{hours:.8f}\t{acc:.2f}")
 
     def __str__(self) -> str:
-        return "\n".join(self._rows)
+        prov = [f"# {k}: {v}" for k, v in self._prov.items()]
+        return "\n".join(prov + self._rows)
 
     def write(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(str(self) + "\n")
+
+
+def run_provenance(data: str, **extra: object) -> dict:
+    """The standard provenance block for a training-curve evidence file.
+
+    ``data`` names the data source honestly — ``"synthetic"`` or
+    ``"real:<path>"``. Platform/device/host and UTC timestamp are filled in
+    from the live environment; pass anything run-specific via ``extra``
+    (e.g. ``argv=" ".join(sys.argv[1:])``).
+    """
+    dev = jax.devices()[0]
+    return {
+        "data": data,
+        "platform": dev.platform,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "n_devices": len(jax.devices()),
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        **extra,
+    }

@@ -35,7 +35,8 @@ export XLA_FLAGS=--xla_force_host_platform_device_count=8
 # stability evidence, not 94%-accuracy evidence. Pass --data-dir through
 # CIFAR_DATA_DIR if real data ever lands.
 DATA_ARGS=()
-[ -n "${CIFAR_DATA_DIR:-}" ] && DATA_ARGS=(--data-dir "$CIFAR_DATA_DIR")
+SUFFIX="_synthetic"   # evidence filenames must say what the data was
+[ -n "${CIFAR_DATA_DIR:-}" ] && { DATA_ARGS=(--data-dir "$CIFAR_DATA_DIR"); SUFFIX=""; }
 run() {
   echo "=== $(date -u +%FT%TZ) $*" >> "$LOG"
   # 9>&- : children must not inherit the flock fd (an orphaned trainer
@@ -44,11 +45,11 @@ run() {
     "$@" >> "$LOG" 2>&1 9>&-
   echo "=== rc=$?" >> "$LOG"
 }
-run --tsv examples/logs/cifar10_dawn_24ep.tsv
+run --tsv "examples/logs/cifar10_dawn_24ep${SUFFIX}.tsv"
 run --compressor topk --compress-ratio 0.01 --memory residual --peak-lr 0.1 \
-    --tsv examples/logs/cifar10_dawn_24ep_topk1pct.tsv
+    --tsv "examples/logs/cifar10_dawn_24ep_topk1pct${SUFFIX}.tsv"
 run --compressor topk --compress-ratio 0.01 --memory residual --peak-lr 0.1 \
     --communicator twoshot \
-    --tsv examples/logs/cifar10_dawn_24ep_topk1pct_twoshot.tsv
+    --tsv "examples/logs/cifar10_dawn_24ep_topk1pct_twoshot${SUFFIX}.tsv"
 rm -f /tmp/cifar_runs.pgid
 echo "=== $(date -u +%FT%TZ) all done" >> "$LOG"
