@@ -63,6 +63,9 @@ def main():
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-warmup-batches", type=int, default=10)
     parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--bucket-cap-mb", type=float, default=32.0,
+                        help="exchange bucket size for backward overlap; "
+                             "0 = one fused launch at the last grad hook")
     args = parser.parse_args()
 
     initialize_distributed()
@@ -79,7 +82,8 @@ def main():
     opt = torch.optim.SGD(model.parameters(), lr=args.lr)
     opt = DistributedOptimizer(opt, grace,
                                named_parameters=model.named_parameters(),
-                               mesh=mesh, seed=args.seed)
+                               mesh=mesh, seed=args.seed,
+                               bucket_cap_mb=args.bucket_cap_mb or None)
 
     rng = np.random.default_rng(args.seed)
     data = torch.from_numpy(rng.standard_normal(
