@@ -15,6 +15,8 @@ sequence axes can be added later without API change.
 
 from __future__ import annotations
 
+import os
+import sys
 from typing import Optional, Sequence
 
 import jax
@@ -72,10 +74,28 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     as independent single-host replicas.
     """
     if coordinator_address is None and num_processes is None and process_id is None:
+        # Markers that say "this process believes it is part of a cluster".
+        # If any is set, an auto-init failure means a MIS-configured cluster
+        # (e.g. SLURM_JOB_ID without the rank/size vars) — dying loudly
+        # beats silently training as independent single-process replicas.
+        # Only a genuinely marker-free environment downgrades to a no-op.
+        markers = [v for v in ("SLURM_JOB_ID", "SLURM_PROCID",
+                               "OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+                               "PMI_RANK", "PMI_SIZE",
+                               "JAX_COORDINATOR_ADDRESS",
+                               "MEGASCALE_COORDINATOR_ADDRESS")
+                   if os.environ.get(v) is not None]
         try:
             jax.distributed.initialize()
-        except Exception:
-            # No cluster env auto-detected: single-process run.
+        except Exception as e:
+            if markers:
+                raise RuntimeError(
+                    f"cluster environment markers {markers} are set but "
+                    f"jax.distributed.initialize() failed — refusing to "
+                    f"fall back to a single-process run") from e
+            print(f"[grace-tpu] no cluster environment auto-detected "
+                  f"({type(e).__name__}: {e}); single-process run",
+                  file=sys.stderr)
             return
     else:
         jax.distributed.initialize(coordinator_address, num_processes, process_id)

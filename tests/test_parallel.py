@@ -124,3 +124,32 @@ class TestWarmupSchedule:
         updates, state = jax.jit(tx.update)(grads, state, params)
         # step 0 update = -base_lr * grad
         np.testing.assert_allclose(np.asarray(updates["w"]), -0.05, rtol=1e-6)
+
+
+class TestInitializeDistributed:
+    """VERDICT round-3 item 9: the auto-detect path must not swallow a
+    *mis-configured* cluster env (silently training as independent
+    single-process replicas); only a genuinely marker-free environment
+    downgrades to a no-op."""
+
+    _MARKERS = ("SLURM_JOB_ID", "SLURM_PROCID", "OMPI_COMM_WORLD_RANK",
+                "OMPI_COMM_WORLD_SIZE", "PMI_RANK", "PMI_SIZE",
+                "JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
+
+    def test_marker_free_env_is_noop(self, monkeypatch):
+        from grace_tpu.parallel import initialize_distributed
+        for v in self._MARKERS:
+            monkeypatch.delenv(v, raising=False)
+        initialize_distributed()   # must not raise
+
+    def test_partial_cluster_env_raises(self, monkeypatch):
+        import pytest
+
+        from grace_tpu.parallel import initialize_distributed
+        for v in self._MARKERS:
+            monkeypatch.delenv(v, raising=False)
+        # SLURM job id present but no rank/size/coordinator: a cluster that
+        # *almost* auto-detects must die loudly, naming the marker.
+        monkeypatch.setenv("SLURM_JOB_ID", "12345")
+        with pytest.raises(RuntimeError, match="SLURM_JOB_ID"):
+            initialize_distributed()
