@@ -179,19 +179,40 @@ def chunk_compress_feedback(flat: jax.Array, residual, k: int,
 # Exchange-side kernel: W gathered chunk payloads -> aggregated dense tensor
 # ---------------------------------------------------------------------------
 
+# Beyond this world size the per-rank accumulation runs as a lax.fori_loop
+# instead of a static unroll: worlds in the hundreds can pass the VMEM gate
+# (e.g. world=256 with ~100 rows still yields bc=384) but a 256-way unroll
+# makes a very long Mosaic program with a correspondingly long compile.
+_AGG_UNROLL_MAX = 32
+
+
 def _make_agg_kernel(main_rows: int, world: int, average: bool):
     def kernel(vals_ref, win_ref, out_ref, tail_ref):
         v = vals_ref[:].astype(jnp.float32)          # (world, bc)
         w = win_ref[:]                               # (world, bc)
         row_iota = jax.lax.broadcasted_iota(
             jnp.int32, (main_rows, v.shape[1]), 0)
-        acc = jnp.zeros((main_rows, v.shape[1]), jnp.float32)
-        tail = jnp.zeros((1, v.shape[1]), jnp.float32)
-        for i in range(world):                       # static unroll, VPU adds
-            acc = acc + jnp.where(row_iota == w[i][None, :],
-                                  v[i][None, :], 0.0)
-            tail = tail + jnp.where((w[i] == main_rows)[None, :],
-                                    v[i][None, :], 0.0)
+        acc0 = jnp.zeros((main_rows, v.shape[1]), jnp.float32)
+        tail0 = jnp.zeros((1, v.shape[1]), jnp.float32)
+
+        def add_rank(vi, wi, carry):
+            acc, tail = carry
+            acc = acc + jnp.where(row_iota == wi, vi, 0.0)
+            tail = tail + jnp.where(wi == main_rows, vi, 0.0)
+            return acc, tail
+
+        if world <= _AGG_UNROLL_MAX:                 # static unroll, VPU adds
+            acc, tail = acc0, tail0
+            for i in range(world):
+                acc, tail = add_rank(v[i][None, :], w[i][None, :],
+                                     (acc, tail))
+        else:
+            def body(i, carry):
+                vi = jax.lax.dynamic_slice_in_dim(v, i, 1, axis=0)
+                wi = jax.lax.dynamic_slice_in_dim(w, i, 1, axis=0)
+                return add_rank(vi, wi, carry)
+
+            acc, tail = jax.lax.fori_loop(0, world, body, (acc0, tail0))
         if average:
             acc = acc / world
             tail = tail / world

@@ -105,3 +105,27 @@ def wire_report(compressor: Compressor, grads: Any) -> CompressionReport:
         leaves.append(LeafReport(path=jax.tree_util.keystr(path),
                                  dense_bytes=dense, wire_bytes=wire))
     return CompressionReport(leaves=tuple(leaves))
+
+
+def debug_nan_residuals(state: Any) -> Dict[str, int]:
+    """NaN census over every floating leaf of a state pytree.
+
+    Debug aid for the fused-kernel NaN contract corner (IMPLEMENTING.md,
+    "Fused local fast path"): under a NaN gradient the fused chunk-Top-K
+    kernel keeps the NaN in the *residual* (re-injected by compensate each
+    step) instead of shipping it on the wire like the staged path, so a
+    poisoned lane is invisible in the loss. Run this periodically over the
+    optimizer/GRACE state (host fetch per offending leaf only) to surface
+    it: returns ``{leaf_path: nan_count}`` for leaves with any NaN —
+    empty dict means clean.
+    """
+    out: Dict[str, int] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in flat:
+        if not (hasattr(leaf, "dtype")
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            continue
+        count = int(jnp.isnan(leaf).sum())
+        if count:
+            out[jax.tree_util.keystr(path)] = count
+    return out

@@ -144,7 +144,10 @@ def test_bf16_buffer_falls_back_to_staged_path():
 
 
 @pytest.mark.parametrize("world,n,ratio", [(1, 1000, 0.01), (4, 1003, 0.013),
-                                           (8, 4096, 0.25)])
+                                           (8, 4096, 0.25),
+                                           # > _AGG_UNROLL_MAX: exercises the
+                                           # lax.fori_loop accumulation path
+                                           (40, 1000, 0.01)])
 def test_aggregate_kernel_matches_staged_exchange(world, n, ratio):
     """Exchange-side kernel == vmapped one-hot decompress + sum + average,
     including colliding indices across ranks and the tail row."""
