@@ -433,11 +433,18 @@ def bench_configs(platform: str, configs, emit) -> None:
             # Config-level caveat (e.g. "bf16 grads use the staged Top-K
             # path") — evidence rows must carry their own context.
             row_extra["note"] = cfg["note"]
-        if os.environ.get("GRACE_DISABLE_PALLAS"):
+        from grace_tpu.ops import _env_true
+        if _env_true("GRACE_DISABLE_PALLAS"):
             # The escape hatch means this row measured the staged XLA path
             # even for configs whose default is the Pallas kernel — the
             # evidence must say so, not attribute the number to the kernel.
+            # _env_true matches pallas_disabled()'s false-spelling semantics
+            # so an explicit "=0" enable is not stamped as staged.
             row_extra["env_pallas_disabled"] = True
+        if _env_true("GRACE_DISABLE_PALLAS_QUANT"):
+            row_extra["env_pallas_quant_disabled"] = True
+        if _env_true("GRACE_DISABLE_PALLAS_TOPK"):
+            row_extra["env_pallas_topk_disabled"] = True
         emit({
             **row_extra,
             "config": name,

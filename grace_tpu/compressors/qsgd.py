@@ -36,7 +36,7 @@ class QSGDCompressor(Compressor):
 
     def _pallas_mode(self):
         from grace_tpu.ops import pallas_disabled
-        if pallas_disabled(explicit=self.use_pallas is True):
+        if pallas_disabled(explicit=self.use_pallas is True, kernel="quant"):
             return False, False
         if self.use_pallas == "auto":
             return jax.default_backend() == "tpu", False

@@ -65,7 +65,7 @@ class TopKCompressor(Compressor):
 
     def _pallas_mode(self):
         from grace_tpu.ops import pallas_disabled
-        if pallas_disabled(explicit=self.use_pallas is True):
+        if pallas_disabled(explicit=self.use_pallas is True, kernel="topk"):
             return False, False
         if self.use_pallas == "auto":
             return jax.default_backend() == "tpu", False

@@ -9,19 +9,33 @@ __all__ = ["pack_bits", "unpack_bits", "pack_2bit", "unpack_2bit",
            "scatter_dense", "pallas_disabled"]
 
 
-def pallas_disabled(explicit: bool = False) -> bool:
+def _env_true(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "no", "off")
+
+
+def pallas_disabled(explicit: bool = False, kernel: str = "") -> bool:
     """Operational escape hatch: GRACE_DISABLE_PALLAS forces every Pallas
     kernel off (set by tools/tpu_watch.sh when the on-chip smoke test
     fails) so a Mosaic compile failure cannot take down a whole run.
-    Warns when it defeats an explicit ``use_pallas=True`` — a forgotten
-    export would otherwise turn the kernel equivalence tests into vacuous
-    staged-vs-staged comparisons. Conventional false spellings ('', '0',
-    'false', 'no', 'off') mean NOT disabled."""
-    if os.environ.get("GRACE_DISABLE_PALLAS", "").strip().lower() in (
-            "", "0", "false", "no", "off"):
+    ``kernel`` scopes the check: GRACE_DISABLE_PALLAS_<KERNEL> (e.g.
+    ``_QUANT``, ``_TOPK``) disables only that kernel family, so one
+    failing Mosaic compile does not force unrelated kernels onto their
+    staged paths (the round-4 smoke failure in the quant kernel disabled
+    the headline Top-K kernels too). Warns when it defeats an explicit
+    ``use_pallas=True`` — a forgotten export would otherwise turn the
+    kernel equivalence tests into vacuous staged-vs-staged comparisons.
+    Conventional false spellings ('', '0', 'false', 'no', 'off') mean NOT
+    disabled."""
+    var = None
+    if _env_true("GRACE_DISABLE_PALLAS"):
+        var = "GRACE_DISABLE_PALLAS"
+    elif kernel and _env_true("GRACE_DISABLE_PALLAS_" + kernel.upper()):
+        var = "GRACE_DISABLE_PALLAS_" + kernel.upper()
+    if var is None:
         return False
     if explicit:
-        warnings.warn("GRACE_DISABLE_PALLAS is set: overriding explicit "
+        warnings.warn(f"{var} is set: overriding explicit "
                       "use_pallas=True; Pallas kernels will NOT run",
                       RuntimeWarning, stacklevel=3)
     return True

@@ -59,7 +59,11 @@ def _make_quantize_kernel(hw_prng: bool):
         else:
             bits = _hash_bits(block_seed, x.shape)
         # Top 24 bits -> uniform [0, 1) with full f32 mantissa coverage.
-        u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        # Mosaic has no uint32->f32 cast (observed on-chip: NotImplementedError
+        # "Unsupported cast: uint32 -> float32"); bits>>8 < 2^24 fits int32
+        # exactly, so the int32 hop is lossless.
+        u = ((bits >> 8).astype(jnp.int32).astype(jnp.float32)
+             * (1.0 / (1 << 24)))
         level = previous + (u < level_float - previous).astype(jnp.float32)
         out_ref[:] = (level * jnp.sign(x)).astype(out_ref.dtype)
 

@@ -115,17 +115,18 @@ def debug_nan_residuals(state: Any) -> Dict[str, int]:
     kernel keeps the NaN in the *residual* (re-injected by compensate each
     step) instead of shipping it on the wire like the staged path, so a
     poisoned lane is invisible in the loss. Run this periodically over the
-    optimizer/GRACE state (host fetch per offending leaf only) to surface
-    it: returns ``{leaf_path: nan_count}`` for leaves with any NaN —
-    empty dict means clean.
+    optimizer/GRACE state to surface it: returns ``{leaf_path: nan_count}``
+    for leaves with any NaN — empty dict means clean. All per-leaf counts
+    are fetched in ONE device-to-host transfer so a state with hundreds of
+    leaves does not serialize hundreds of blocking syncs.
     """
-    out: Dict[str, int] = {}
     flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    paths, counts = [], []
     for path, leaf in flat:
         if not (hasattr(leaf, "dtype")
                 and jnp.issubdtype(leaf.dtype, jnp.floating)):
             continue
-        count = int(jnp.isnan(leaf).sum())
-        if count:
-            out[jax.tree_util.keystr(path)] = count
-    return out
+        paths.append(jax.tree_util.keystr(path))
+        counts.append(jnp.isnan(leaf).sum())
+    counts = jax.device_get(counts)
+    return {p: int(c) for p, c in zip(paths, counts) if int(c)}

@@ -58,7 +58,13 @@ def _row_table(rows, title, value_key="imgs_per_sec",
            "|---|---|---|---|---|" + ("---|" if spread else "")]
     rows = [r for r in rows if r.get("config")]   # skip _meta-style rows
     for r in rows:
-        flags = " ⚠staged" if r.get("env_pallas_disabled") else ""
+        flags = ""
+        if r.get("env_pallas_disabled"):
+            flags = " ⚠staged"
+        elif r.get("env_pallas_quant_disabled"):
+            # Scoped disable: only quant-kernel configs measured staged.
+            flags = " ⚠staged-quant" if "qsgd" in (r.get("config") or "") \
+                else ""
         if r.get("error"):
             out.append(f"| {r.get('config')} | ERROR: {r['error'][:60]} |"
                        + " — |" * (3 + spread))

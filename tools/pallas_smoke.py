@@ -3,32 +3,41 @@
 tpu_watch.sh runs this right after a successful tunnel probe and BEFORE the
 benches: the fused chunk-Top-K kernel (ops/pallas_topk.py) is on the
 headline path (use_pallas='auto'), so a Mosaic compile failure on the real
-chip would otherwise crash every bench attempt. On failure the watcher
-exports GRACE_DISABLE_PALLAS=1 so the benches measure the staged XLA path
-instead of measuring nothing.
+chip would otherwise crash every bench attempt. Per-kernel verdicts (round-4
+postmortem: a Mosaic cast failure in the *quant* kernel used to disable the
+headline *topk* kernels too, costing the whole fused-path measurement):
 
-Exit 0 = kernel compiled and matches the staged path on-device.
+Exit 0 = all kernels compiled and match their staged paths on-device.
+Exit 3 = topk kernels OK, quant kernel failed — the watcher exports
+         GRACE_DISABLE_PALLAS_QUANT=1 only.
+Exit 1 = topk failed — the watcher exports GRACE_DISABLE_PALLAS=1.
+Exit 2 = not on TPU (treated as full failure by the watcher).
 """
 
 from __future__ import annotations
 
 import os
 import sys
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> int:
+def _require(ok: bool, msg: str) -> None:
+    # NOT assert: PYTHONOPTIMIZE in the watcher's inherited environment
+    # would strip asserts and turn the gatekeeper into a no-op.
+    if not ok:
+        raise RuntimeError(msg)
+
+
+def _check_topk() -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    if jax.devices()[0].platform != "tpu":
-        print("smoke: not on tpu", file=sys.stderr)
-        return 2
-
     from grace_tpu.compressors import TopKCompressor
-    from grace_tpu.ops.pallas_topk import chunk_compress_feedback
+    from grace_tpu.ops.pallas_topk import (chunk_aggregate_dense,
+                                           chunk_compress_feedback)
 
     n, ratio = 1_000_000, 0.01
     k = max(1, int(n * ratio))
@@ -44,19 +53,13 @@ def main() -> int:
     rvals, ridx = map(np.asarray, payload)
 
     idx = win * k + np.arange(k)
-    if not np.array_equal(idx, ridx):
-        print("smoke: index mismatch", file=sys.stderr)
-        return 1
-    if not np.array_equal(vals, rvals):
-        print("smoke: value mismatch", file=sys.stderr)
-        return 1
+    _require(np.array_equal(idx, ridx), "index mismatch")
+    _require(np.array_equal(vals, rvals), "value mismatch")
     dense = np.zeros(n, np.float32)
     dense[idx] = vals
-    if not np.array_equal(new_resid, np.asarray(flat + resid) - dense):
-        print("smoke: residual mismatch", file=sys.stderr)
-        return 1
+    _require(np.array_equal(new_resid, np.asarray(flat + resid) - dense),
+             "residual mismatch")
     # Exchange-side kernel: W=8 gathered payloads vs the staged vmap path.
-    from grace_tpu.ops.pallas_topk import chunk_aggregate_dense
     world = 8
     xs = jax.random.normal(jax.random.key(3), (world, n), jnp.float32)
     payloads = [ref.compress(xs[w], None, jax.random.key(4))[0]
@@ -68,33 +71,64 @@ def main() -> int:
         lambda v, i: ref.decompress((v, i), ctx))(gvals, gidx), axis=0)
     fused = chunk_aggregate_dense(gvals, (gidx // k).astype(jnp.int32), k, n,
                                   average=True)
-    if not np.allclose(np.asarray(fused), np.asarray(staged), atol=1e-6):
-        print("smoke: aggregate kernel mismatch", file=sys.stderr)
-        return 1
+    _require(np.allclose(np.asarray(fused), np.asarray(staged), atol=1e-6),
+             "aggregate kernel mismatch")
 
-    # QSGD quant kernel (ops/pallas_quant.py): the watcher's
-    # degrade-to-staged hatch must also cover a Mosaic failure here, since
-    # the sweep's qsgd_pallas row enables it. Bit-exact comparison against
-    # the staged path is impossible (different PRNG bit source), so check
-    # the deterministic invariants of stochastic rounding instead: every
-    # |level| within the floor/ceil envelope of |x|·q/||x||, sign folded.
+
+def _check_quant() -> None:
+    # QSGD quant kernel (ops/pallas_quant.py): the sweep's qsgd_pallas row
+    # enables it. Bit-exact comparison against the staged path is impossible
+    # (different PRNG bit source), so check the deterministic invariants of
+    # stochastic rounding instead: every |level| within the floor/ceil
+    # envelope of |x|*q/||x||, sign folded.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from grace_tpu.ops.pallas_quant import quantize_stochastic
+
+    flat = jax.random.normal(jax.random.key(0), (1_000_000,), jnp.float32)
     q = 64
     norm = jnp.linalg.norm(flat)
     levels = np.asarray(quantize_stochastic(flat, norm, jnp.int32(7), q)
                         ).astype(np.float64)
     lf = np.abs(np.asarray(flat, np.float64)) * (q / float(norm))
     mag = np.abs(levels)
-    if not ((mag >= np.floor(lf) - 1e-6) & (mag <= np.ceil(lf) + 1e-6)).all():
-        print("smoke: qsgd level outside floor/ceil envelope",
-              file=sys.stderr)
-        return 1
-    if (np.sign(levels) * np.sign(np.asarray(flat)) < 0).any():
-        print("smoke: qsgd sign mismatch", file=sys.stderr)
-        return 1
+    _require(((mag >= np.floor(lf) - 1e-6)
+              & (mag <= np.ceil(lf) + 1e-6)).all(),
+             "qsgd level outside floor/ceil envelope")
+    _require(not (np.sign(levels) * np.sign(np.asarray(flat)) < 0).any(),
+             "qsgd sign mismatch")
+    # Stochastic rounding must actually round both ways (a broken PRNG that
+    # returns all zeros would floor everything and still pass the envelope).
+    frac = lf - np.floor(lf)
+    informative = (frac > 0.25) & (frac < 0.75)
+    went_up = mag[informative] > np.floor(lf[informative]) + 0.5
+    _require(0.05 < went_up.mean() < 0.95, "qsgd rounding is not stochastic")
 
-    print("smoke: pallas chunk-topk + qsgd-quant kernels OK on",
-          jax.devices()[0])
+
+def main() -> int:
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        print("smoke: not on tpu", file=sys.stderr)
+        return 2
+
+    try:
+        _check_topk()
+    except Exception:
+        traceback.print_exc()
+        print("smoke: TOPK kernels FAILED", file=sys.stderr)
+        return 1
+    print("smoke: pallas chunk-topk kernels OK on", jax.devices()[0])
+
+    try:
+        _check_quant()
+    except Exception:
+        traceback.print_exc()
+        print("smoke: QUANT kernel FAILED (topk OK)", file=sys.stderr)
+        return 3
+    print("smoke: pallas qsgd-quant kernel OK on", jax.devices()[0])
     return 0
 
 

@@ -12,6 +12,7 @@ cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watch.log
 BENCH_ATTEMPTS=0
 ORIG_GDP="${GRACE_DISABLE_PALLAS:-}"
+ORIG_GDPQ="${GRACE_DISABLE_PALLAS_QUANT:-}"
 # Single instance via flock (stop with: tools/tpu_watch.sh stop).
 # pkill -f tpu_watch matches the *caller's own shell* when the launch
 # command line contains the script path — that footgun killed two watcher
@@ -100,16 +101,31 @@ while true; do
     # kernel. An operator-set GRACE_DISABLE_PALLAS from the launch
     # environment is preserved either way (ORIG_GDP).
     pause_cpu_jobs
-    if run_py 420 python tools/pallas_smoke.py; then
-      if [ -n "$ORIG_GDP" ]; then
-        export GRACE_DISABLE_PALLAS="$ORIG_GDP"
-      else
-        unset GRACE_DISABLE_PALLAS
-      fi
+    run_py 420 python tools/pallas_smoke.py
+    smoke_rc=$?
+    # Restore operator-set values first, then layer the smoke verdict on
+    # top. rc=3 means the topk kernels (the headline path) are fine and
+    # only the quant kernel must degrade — a quant Mosaic failure used to
+    # disable ALL kernels, silently benching the staged topk path.
+    if [ -n "$ORIG_GDP" ]; then
+      export GRACE_DISABLE_PALLAS="$ORIG_GDP"
     else
+      unset GRACE_DISABLE_PALLAS
+    fi
+    if [ -n "$ORIG_GDPQ" ]; then
+      export GRACE_DISABLE_PALLAS_QUANT="$ORIG_GDPQ"
+    else
+      unset GRACE_DISABLE_PALLAS_QUANT
+    fi
+    if [ "$smoke_rc" -eq 3 ]; then
+      export GRACE_DISABLE_PALLAS_QUANT=1
+      echo "=== $(date -u +%FT%TZ) pallas QUANT smoke failed — benching" \
+           "with GRACE_DISABLE_PALLAS_QUANT=1 (topk kernels stay on)" \
+           >> "$LOG"
+    elif [ "$smoke_rc" -ne 0 ]; then
       export GRACE_DISABLE_PALLAS=1
-      echo "=== $(date -u +%FT%TZ) pallas smoke FAILED — benching with" \
-           "GRACE_DISABLE_PALLAS=1" >> "$LOG"
+      echo "=== $(date -u +%FT%TZ) pallas smoke FAILED (rc=$smoke_rc) —" \
+           "benching with GRACE_DISABLE_PALLAS=1" >> "$LOG"
     fi
     run_py 1800 python bench.py --_worker tpu
     rc1=$?
