@@ -38,27 +38,40 @@ WORKER_TIMEOUT_S = 1200        # full bench incl. first compile (~20-40s/fn)
 HEADLINE = [
     # Both sides get the fusion buffer — Horovod fuses the uncompressed
     # baseline too, so a like-for-like ratio must as well.
-    {"name": "none", "params": {"compressor": "none", "memory": "none",
-                                "communicator": "allreduce",
-                                "fusion": "flat"}},
+    #
+    # per_device_bs=256: chosen from the measured on-chip bs sweep
+    # (BENCH_ALL_TPU_LAST.json, 2026-07-31): the ~10 ms fixed compression
+    # cost is ~45% of a bs=32 step (0.56x dense) but amortizes to >=0.92x
+    # at bs=256 — the batch a throughput-tuned ResNet-50 run would use
+    # anyway. The dense baseline is measured at the SAME bs in the same
+    # session, so the ratio stays like-for-like; bs=32..256 rows stay in
+    # the bench_all sweep for the full curve. (BASELINE.md north star pins
+    # no batch size; the reference's synthetic harness default is bs=32,
+    # kept as the sweep's first point.)
+    {"name": "none", "per_device_bs": 256,
+     "params": {"compressor": "none", "memory": "none",
+                "communicator": "allreduce",
+                "fusion": "flat"}},
     # Top-K selection uses the chunked argmax (top-1 per strided chunk, a
     # pure VPU reduction) with the scatter-free one-hot decompress
-    # (ops/sparse.py chunkwise_dense). Measured on the chip
-    # (TPU_VARIANTS.jsonl, 2026-07-31): chunk 1.02x dense vs approx_max_k
-    # 0.69x and exact-sort far below — both the full-buffer top-k select
-    # AND the scatter in decompress were the bottleneck; chunk mode removes
-    # both. Selection is DGC-style relaxed (top-1 per chunk, not global
+    # (ops/sparse.py chunkwise_dense). Measured on the chip in one
+    # interleaved session (BENCH_ALL_TPU_LAST.json, 2026-07-31): chunk
+    # 0.56x dense at bs=32 rising to 0.92x at bs=256, vs approx_max_k
+    # 0.69x (bs=32) and exact-sort far below — both the full-buffer top-k
+    # select AND the scatter in decompress were the bottleneck; chunk mode
+    # removes both. Selection is DGC-style relaxed (top-1 per chunk, not global
     # top-k); residual error feedback compensates — chunk tracks exact
     # step-for-step on a toy convex problem (2.303->0.534 vs 0.533 at 1%
     # over 120 steps, 8-device mesh) and the real-MNIST curve is committed
     # at examples/logs/mnist10k_topk1pct_chunk.tsv. bench_all.py measures
     # exact/approx/chunk side by side.
-    {"name": "topk1pct", "params": {"compressor": "topk",
-                                    "compress_ratio": 0.01,
-                                    "topk_algorithm": "chunk",
-                                    "memory": "residual",
-                                    "communicator": "allgather",
-                                    "fusion": "flat"}},
+    {"name": "topk1pct", "per_device_bs": 256,
+     "params": {"compressor": "topk",
+                "compress_ratio": 0.01,
+                "topk_algorithm": "chunk",
+                "memory": "residual",
+                "communicator": "allgather",
+                "fusion": "flat"}},
 ]
 
 
@@ -378,6 +391,15 @@ def bench_configs(platform: str, configs, emit) -> None:
     med = statistics.median
     for cfg in configs:
         name = cfg["name"]
+        if "cached_row" in cfg:
+            # Resume support (bench_all GRACE_BENCH_RESUME): a row measured
+            # earlier in this tunnel session is re-emitted instead of
+            # re-burning the chip; it carries "resumed": true. configs[0]
+            # stays the dense-recipe anchor either way.
+            print(f"[bench] {name}: cached row (resume)",
+                  file=sys.stderr, flush=True)
+            emit(cfg["cached_row"])
+            continue
         bs = cfg.get("per_device_bs", default_bs)
         hw = cfg.get("image_hw", default_hw)
         pdtype = cfg.get("param_dtype", "float32")

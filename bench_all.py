@@ -171,13 +171,57 @@ SWEEP_EVIDENCE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL_TPU_LAST.json")
 
 
+def _resume_configs():
+    """Attach previously measured rows (persisted in SWEEP_EVIDENCE_PATH)
+    as cached_row so bench_configs re-emits them instead of re-measuring —
+    a retry after a mid-sweep tunnel death then only pays for the missing
+    configs. Two gates (a stale last-week file must never replay as fresh):
+
+    * GRACE_BENCH_RESUME — explicit operator override, any file accepted;
+    * GRACE_BENCH_RESUME_SINCE=<unix epoch> — set by tools/tpu_watch.sh at
+      watcher start: the file is only reused if its captured_at stamp is
+      at/after that moment, i.e. it was written by this watcher run.
+
+    Rows must match the config's current shapes (bs/hw/dtype), carry a real
+    measurement (no error rows), and get "resumed": true stamped on."""
+    configs = [dict(c) for c in CONFIGS]
+    explicit = os.environ.get("GRACE_BENCH_RESUME")
+    since = os.environ.get("GRACE_BENCH_RESUME_SINCE")
+    if not (explicit or since):
+        return configs
+    try:
+        with open(SWEEP_EVIDENCE_PATH) as f:
+            doc = json.load(f)
+        if not explicit:
+            from datetime import datetime
+            captured = datetime.fromisoformat(doc["captured_at"]).timestamp()
+            if captured < float(since):
+                return configs
+        prev = {r["config"]: r for r in doc.get("rows", [])
+                if r.get("config") and r.get("imgs_per_sec") is not None}
+    except Exception:
+        return configs
+    for cfg in configs:
+        row = prev.get(cfg["name"])
+        if not row:
+            continue
+        want = (cfg.get("per_device_bs", 32), cfg.get("image_hw", 224),
+                cfg.get("param_dtype", "float32"))
+        got = (row.get("per_device_bs"), row.get("image_hw"),
+               row.get("param_dtype"))
+        if want == got:
+            cfg["cached_row"] = {**row, "resumed": True}
+    return configs
+
+
 def _worker(platform: str) -> None:
+    configs = _resume_configs()
     emit = bench.progressive_emit(
         lambda r: print(json.dumps(r), flush=True),
-        n_expected=len(CONFIGS),
+        n_expected=len(configs),
         evidence_path=SWEEP_EVIDENCE_PATH,
         metric="resnet50_all_configs_imgs_per_sec")
-    bench.bench_configs(platform, CONFIGS, emit)
+    bench.bench_configs(platform, configs, emit)
 
 
 def main() -> None:

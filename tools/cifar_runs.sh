@@ -37,19 +37,34 @@ export XLA_FLAGS=--xla_force_host_platform_device_count=8
 DATA_ARGS=()
 SUFFIX="_synthetic"   # evidence filenames must say what the data was
 [ -n "${CIFAR_DATA_DIR:-}" ] && { DATA_ARGS=(--data-dir "$CIFAR_DATA_DIR"); SUFFIX=""; }
-run() {
-  echo "=== $(date -u +%FT%TZ) $*" >> "$LOG"
+run() {  # run <tsv> <extra args...>
+  local tsv=$1; shift
+  # Skip curves that already have all 24 epochs (the trainer cannot resume
+  # mid-run, so a complete TSV is the only state worth keeping; anything
+  # partial is re-run from scratch). Epoch rows start with a digit —
+  # header/provenance lines do not.
+  local done_epochs
+  # NOT `|| echo 0`: grep -c already prints 0 (while exiting 1) on a
+  # match-less file, and the fallback would append a second line.
+  done_epochs=$(grep -c '^[0-9]' "$tsv" 2>/dev/null)
+  done_epochs=${done_epochs:-0}
+  if [ "$done_epochs" -ge 24 ]; then
+    echo "=== $(date -u +%FT%TZ) skip (complete, $done_epochs epochs): $tsv" \
+         >> "$LOG"
+    return 0
+  fi
+  echo "=== $(date -u +%FT%TZ) --tsv $tsv $*" >> "$LOG"
   # 9>&- : children must not inherit the flock fd (an orphaned trainer
   # would hold the lock for hours and block restarts).
   python examples/cifar10_dawn.py --epochs 24 ${DATA_ARGS[@]+"${DATA_ARGS[@]}"} \
-    "$@" >> "$LOG" 2>&1 9>&-
+    --tsv "$tsv" "$@" >> "$LOG" 2>&1 9>&-
   echo "=== rc=$?" >> "$LOG"
 }
-run --tsv "examples/logs/cifar10_dawn_24ep${SUFFIX}.tsv"
-run --compressor topk --compress-ratio 0.01 --memory residual --peak-lr 0.1 \
-    --tsv "examples/logs/cifar10_dawn_24ep_topk1pct${SUFFIX}.tsv"
-run --compressor topk --compress-ratio 0.01 --memory residual --peak-lr 0.1 \
-    --communicator twoshot \
-    --tsv "examples/logs/cifar10_dawn_24ep_topk1pct_twoshot${SUFFIX}.tsv"
+run "examples/logs/cifar10_dawn_24ep${SUFFIX}.tsv"
+run "examples/logs/cifar10_dawn_24ep_topk1pct${SUFFIX}.tsv" \
+    --compressor topk --compress-ratio 0.01 --memory residual --peak-lr 0.1
+run "examples/logs/cifar10_dawn_24ep_topk1pct_twoshot${SUFFIX}.tsv" \
+    --compressor topk --compress-ratio 0.01 --memory residual --peak-lr 0.1 \
+    --communicator twoshot
 rm -f /tmp/cifar_runs.pgid
 echo "=== $(date -u +%FT%TZ) all done" >> "$LOG"

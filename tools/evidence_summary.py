@@ -58,13 +58,18 @@ def _row_table(rows, title, value_key="imgs_per_sec",
            "|---|---|---|---|---|" + ("---|" if spread else "")]
     rows = [r for r in rows if r.get("config")]   # skip _meta-style rows
     for r in rows:
+        cfg_name = r.get("config") or ""
         flags = ""
         if r.get("env_pallas_disabled"):
             flags = " ⚠staged"
-        elif r.get("env_pallas_quant_disabled"):
-            # Scoped disable: only quant-kernel configs measured staged.
-            flags = " ⚠staged-quant" if "qsgd" in (r.get("config") or "") \
-                else ""
+        elif r.get("env_pallas_quant_disabled") and "qsgd" in cfg_name:
+            # Scoped disables: flag only the configs whose kernel family
+            # was forced onto the staged path.
+            flags = " ⚠staged-quant"
+        elif r.get("env_pallas_topk_disabled") and "topk" in cfg_name:
+            flags = " ⚠staged-topk"
+        if r.get("resumed"):
+            flags += " ↻resumed"
         if r.get("error"):
             out.append(f"| {r.get('config')} | ERROR: {r['error'][:60]} |"
                        + " — |" * (3 + spread))

@@ -27,39 +27,55 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 N = 25_557_032          # ResNet-50 fused gradient element count
 K = N // 100
 ITERS = 20
+ALLOW_CPU = False       # --allow-cpu: script self-test off-chip (tiny N)
 
 
-def timed(name, make_body, *args):
+def timed(name, make_body, *args, carry0=None):
     """make_body(carry, *args) -> new carry (same shape/dtype as carry)."""
     import jax
+    import jax.numpy as jnp
     from jax import lax
 
     @jax.jit
     def run(c0, *a):
         def body(i, c):
-            # i-dependent perturbation pins the body inside the loop.
-            return make_body(c + i * 1e-12, *a)
+            # i-dependent perturbation pins the body inside the loop
+            # (float leaves only: int leaves like a step counter must keep
+            # their dtype or the fori_loop carry type check fails).
+            def pin(x):
+                if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+                    return x + i * 1e-12
+                return x
+            return jax.tree.map(pin, make_body(c, *a))
         return lax.fori_loop(0, ITERS, body, c0)
 
-    c0 = args[0] * 0.0 + 1.0 if False else None  # placeholder, unused
-    import jax.numpy as jnp
-    c0 = jnp.zeros((N,), jnp.float32)
+    c0 = jnp.zeros((N,), jnp.float32) if carry0 is None else carry0
     out = run(c0, *args)
-    out.block_until_ready()
-    float(out[0])
+    first = jax.tree.leaves(out)[0]
+    float(first.reshape(-1)[0])
     t0 = time.perf_counter()
     out = run(c0, *args)
-    float(out[0])
+    float(jax.tree.leaves(out)[0].reshape(-1)[0])
     dt = (time.perf_counter() - t0) / ITERS
     print(f"{name:34s} {dt*1e3:8.3f} ms/iter", flush=True)
 
 
 def main() -> None:
     import jax
+
+    if ALLOW_CPU:
+        # The dev image's sitecustomize imports jax at interpreter start
+        # and pins the axon (real-TPU-tunnel) platform, so JAX_PLATFORMS
+        # in the environment is too late — a CPU self-test would silently
+        # grab the one real chip and contend with any in-flight bench.
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
+    import numpy as np
     from jax import lax
 
-    assert jax.devices()[0].platform == "tpu"
+    if not ALLOW_CPU:
+        assert jax.devices()[0].platform == "tpu"
     flat = jax.random.normal(jax.random.key(0), (N,), jnp.float32)
     resid = jax.random.normal(jax.random.key(1), (N,), jnp.float32)
     k = K
@@ -113,6 +129,124 @@ def main() -> None:
 
     timed("gather-free chunk pipeline", gatherfree_pipeline)
 
+    # ---- round-4 additions: the pieces the headline ACTUALLY runs -------
+    # (fusion='flat' + chunk Top-K with the fused Pallas kernels; the rows
+    # above are the staged building blocks, these are the deployed paths.)
+    from grace_tpu.ops.pallas_topk import (chunk_aggregate_dense,
+                                           chunk_compress_feedback)
+
+    def pallas_fused(c):
+        vals, win, new_resid = chunk_compress_feedback(
+            flat, c, k, interpret=ALLOW_CPU)
+        return new_resid + vals[0] * 1e-20
+
+    timed("pallas fused compress+residual", pallas_fused)
+
+    world = 8
+    gvals = jax.random.normal(jax.random.key(5), (world, k), jnp.float32)
+    gwin = jax.random.randint(jax.random.key(6), (world, k), 0, rows,
+                              dtype=jnp.int32)
+
+    def pallas_agg(c):
+        # c[0]-dependence keeps the aggregate inside the loop.
+        dense = chunk_aggregate_dense(gvals + c[0] * 1e-20, gwin, k, N,
+                                      average=True, interpret=ALLOW_CPU)
+        return c * 1e-20 + dense
+
+    timed(f"pallas aggregate W={world}", pallas_agg)
+
+    # Leaf plumbing around the fused buffer: ResNet-50's real leaf shapes —
+    # unless N was overridden (script self-test off-chip), in which case
+    # synthesize a same-cardinality split of N so every stage scales down.
+    if N == 25_557_032:
+        from grace_tpu.models import resnet
+        pshapes = jax.eval_shape(
+            lambda key: resnet.init(key, depth=50, num_classes=1000)[0],
+            jax.random.key(0))
+        shapes = [s.shape for s in jax.tree.leaves(pshapes)]
+    else:
+        n_leaves = 160
+        per = max(1, N // n_leaves)
+        shapes = [(per,)] * (n_leaves - 1) + [(N - per * (n_leaves - 1),)]
+    total = sum(int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes)
+    print(f"resnet50 leaves={len(shapes)} total={total}", flush=True)
+    leaves = [jax.random.normal(jax.random.key(10 + j), s, jnp.float32)
+              for j, s in enumerate(shapes)]
+
+    def concat_leaves(c):
+        scaled = [leaves[0] * (1.0 + c[0] * 1e-20)] + leaves[1:]
+        flat_all = jnp.concatenate([jnp.ravel(l) for l in scaled])
+        return c * 1e-20 + jnp.zeros((N,), jnp.float32
+                                     ).at[:flat_all.size].set(flat_all[:N])
+
+    timed(f"concat {len(shapes)} leaves", concat_leaves)
+
+    def concat_split(lvs):
+        flat_all = jnp.concatenate([jnp.ravel(l) for l in lvs])
+        out, off = [], 0
+        for s in shapes:
+            size = int(np.prod(s, dtype=np.int64)) if s else 1
+            out.append(flat_all[off:off + size].reshape(s))
+            off += size
+        return out
+
+    timed("concat+split round trip", concat_split, carry0=leaves)
+
+    # End-to-end transform.update — everything the compressed step does on
+    # top of forward/backward/SGD: compensate, chunk-select (Pallas),
+    # extract, residual, allgather (1 device), aggregate-decompress,
+    # plus the concat/split plumbing. Init runs inside the timed fn but is
+    # amortized over ITERS and is just zeros. Carry feeds each step's
+    # output gradients back in, so the loop is honest.
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from grace_tpu import grace_from_params
+    from grace_tpu.parallel import data_parallel_mesh
+
+    mesh = data_parallel_mesh()
+
+    for fusion, label in (("flat", "transform update (fusion=flat)"),
+                          (None, "transform update (per-leaf)")):
+        grc = grace_from_params({"compressor": "topk",
+                                 "compress_ratio": 0.01,
+                                 "topk_algorithm": "chunk",
+                                 "memory": "residual",
+                                 "communicator": "allgather",
+                                 "fusion": fusion})
+        tx = grc.transform(seed=0)
+
+        def inner(lvs, _tx=tx):
+            st = _tx.init(lvs)
+
+            def body(i, carry):
+                st, lv = carry
+                out, st2 = _tx.update(lv, st)
+                out = [o + i * 1e-12 for o in out]
+                return (st2, out)
+
+            _, out = lax.fori_loop(0, ITERS, body, (st, lvs))
+            return out
+
+        fn = jax.jit(shard_map(inner, mesh=mesh,
+                               in_specs=(P(),), out_specs=P(),
+                               check_rep=False))
+        t_out = fn(leaves)
+        float(jax.tree.leaves(t_out)[0].reshape(-1)[0])
+        t0 = time.perf_counter()
+        t_out = fn(leaves)
+        float(jax.tree.leaves(t_out)[0].reshape(-1)[0])
+        dt = (time.perf_counter() - t0) / ITERS
+        print(f"{label:34s} {dt*1e3:8.3f} ms/iter", flush=True)
+
 
 if __name__ == "__main__":
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=N)
+    ap.add_argument("--iters", type=int, default=ITERS)
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="self-test the script off-chip (pair with small"
+                         " --n; timings are meaningless)")
+    a = ap.parse_args()
+    N, K, ITERS, ALLOW_CPU = a.n, max(1, a.n // 100), a.iters, a.allow_cpu
     main()

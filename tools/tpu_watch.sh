@@ -13,6 +13,9 @@ LOG=tpu_watch.log
 BENCH_ATTEMPTS=0
 ORIG_GDP="${GRACE_DISABLE_PALLAS:-}"
 ORIG_GDPQ="${GRACE_DISABLE_PALLAS_QUANT:-}"
+# Sweep rows measured at/after this moment may be resumed by retry
+# attempts instead of re-measured (see the bench_all invocation below).
+export GRACE_BENCH_RESUME_SINCE="$(date -u +%s)"
 # Single instance via flock (stop with: tools/tpu_watch.sh stop).
 # pkill -f tpu_watch matches the *caller's own shell* when the launch
 # command line contains the script path — that footgun killed two watcher
@@ -138,6 +141,13 @@ while true; do
       echo "=== $(date -u +%FT%TZ) per-algorithm sweep" >> "$LOG"
       # 12000s: the sweep grew the bs-sweep + qsgd_pallas rows (round 4)
       # and each row now brackets itself with interleaved dense samples.
+      # Retry attempts resume: rows persisted by an earlier attempt are
+      # re-emitted, not re-measured (a hung remote compile once burned 9
+      # already-measured rows). GRACE_BENCH_RESUME_SINCE (exported at
+      # watcher start, below the lock) lets bench_all reject evidence
+      # files older than this watcher run, so a stale last-week sweep
+      # can never replay as fresh; GRACE_BENCH_RESUME remains the
+      # operator's explicit this-file-is-fresh override.
       run_py 12000 python bench_all.py --_worker tpu
       rc2=$?
       echo "=== sweep rc=$rc2" >> "$LOG"
