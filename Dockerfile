@@ -18,10 +18,10 @@ WORKDIR /grace
 # at install time via setup hooks or on first use through ctypes.
 RUN apt-get update && apt-get install -y --no-install-recommends \
         g++ cmake ninja-build make && rm -rf /var/lib/apt/lists/*
-COPY requirements.lock pyproject.toml ./
+COPY requirements.lock pyproject.toml README.md ./
 COPY grace_tpu ./grace_tpu
 COPY native ./native
-COPY examples /examples
+COPY examples ./examples
 RUN pip install --no-cache-dir -r requirements.lock && \
     pip install --no-cache-dir -e .
 

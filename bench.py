@@ -450,7 +450,7 @@ def bench_configs(platform: str, configs, emit) -> None:
               f"(x{imgs / base_med:.3f} vs dense, spread {spread:.1f}%)"
               + (f", mfu={mfu:.4f}" if mfu is not None else ""),
               file=sys.stderr, flush=True)
-        row_extra = {}
+        row_extra = {"grace_params": cfg["params"]}
         if cfg.get("note"):
             # Config-level caveat (e.g. "bf16 grads use the staged Top-K
             # path") — evidence rows must carry their own context.

@@ -209,8 +209,18 @@ def _resume_configs():
                 cfg.get("param_dtype", "float32"))
         got = (row.get("per_device_bs"), row.get("image_hw"),
                row.get("param_dtype"))
-        if want == got:
-            cfg["cached_row"] = {**row, "resumed": True}
+        if want != got:
+            continue
+        # Same name + shapes is not enough: a config whose *params* were
+        # edited since the row was measured must re-measure. Rows stamp
+        # grace_params (bench_configs); a row without the stamp predates
+        # it and is only trusted under the explicit operator override.
+        if "grace_params" in row:
+            if row["grace_params"] != cfg["params"]:
+                continue
+        elif not explicit:
+            continue
+        cfg["cached_row"] = {**row, "resumed": True}
     return configs
 
 

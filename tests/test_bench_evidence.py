@@ -4,9 +4,9 @@ The evidence files are the round's crown jewels (the tunnel dies for hours
 at a stretch, so whatever landed on disk is often all there is). These
 tests pin the protection logic: row-by-row persistence, atomicity of the
 write, and the no-regression rule that keeps a fresh 1-row partial from
-clobbering an earlier complete record.
-
-No jax/device needed — everything here is host-side file logic.
+clobbering an earlier complete record; plus the sweep-resume gates
+(bench_all) and the cached-row passthrough — the passthrough test calls
+bench_configs, which does initialize the (CPU) jax backend.
 """
 
 import json
@@ -96,3 +96,106 @@ def test_headline_metric_prefers_topk_row(tmp_path):
     emit(_row("topk1pct", 42.0))         # compressed row can land first
     rec = json.load(open(path))
     assert rec["value"] == 42.0 and rec["mfu"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# Sweep resume (bench_all._resume_configs + bench_configs cached_row)
+# ---------------------------------------------------------------------------
+
+import datetime  # noqa: E402
+
+import bench_all  # noqa: E402
+
+
+def _evidence_file(tmp_path, captured_at=None, rows=()):
+    doc = {"metric": "resnet50_all_configs_imgs_per_sec",
+           "captured_at": captured_at
+           or datetime.datetime.now(datetime.timezone.utc).isoformat(),
+           "rows": list(rows)}
+    path = tmp_path / "sweep.json"
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def _sweep_row(config, bs=32, hw=224, pdtype="float32", **extra):
+    row = {"config": config, "imgs_per_sec": 100.0, "vs_baseline": 0.9,
+           "per_device_bs": bs, "image_hw": hw, "param_dtype": pdtype,
+           "platform": "tpu", **extra}
+    for c in bench_all.CONFIGS:       # stamp the real params, like bench.py
+        if c["name"] == config:
+            row.setdefault("grace_params", c["params"])
+    return row
+
+
+def _patch_evidence(monkeypatch, path):
+    monkeypatch.setattr(bench_all, "SWEEP_EVIDENCE_PATH", path)
+
+
+def test_resume_no_gate_no_cache(tmp_path, monkeypatch):
+    _patch_evidence(monkeypatch, _evidence_file(
+        tmp_path, rows=[_sweep_row("topk1pct_bs64", bs=64)]))
+    monkeypatch.delenv("GRACE_BENCH_RESUME", raising=False)
+    monkeypatch.delenv("GRACE_BENCH_RESUME_SINCE", raising=False)
+    assert not any("cached_row" in c for c in bench_all._resume_configs())
+
+
+def test_resume_explicit_matches_shapes_and_skips_errors(tmp_path,
+                                                         monkeypatch):
+    _patch_evidence(monkeypatch, _evidence_file(tmp_path, rows=[
+        _sweep_row("topk1pct_bs64", bs=64),
+        _sweep_row("topk1pct", bs=32),       # headline is bs=256 now
+        {"config": "signsgd_vote", "error": "boom", "per_device_bs": 32,
+         "image_hw": 224, "param_dtype": "float32"},
+    ]))
+    monkeypatch.setenv("GRACE_BENCH_RESUME", "1")
+    monkeypatch.delenv("GRACE_BENCH_RESUME_SINCE", raising=False)
+    cfgs = bench_all._resume_configs()
+    cached = {c["name"]: c["cached_row"] for c in cfgs if "cached_row" in c}
+    assert set(cached) == {"topk1pct_bs64"}
+    assert cached["topk1pct_bs64"]["resumed"] is True
+
+
+def test_resume_rejects_edited_params(tmp_path, monkeypatch):
+    # Same name + shapes but different grace_params (config edited since
+    # the row was measured) -> re-measure; a row with no stamp at all is
+    # trusted only under the explicit operator override.
+    edited = _sweep_row("topk1pct_bs64", bs=64)
+    edited["grace_params"] = {**edited["grace_params"],
+                              "compress_ratio": 0.05}
+    unstamped = _sweep_row("topk1pct_bs128", bs=128)
+    del unstamped["grace_params"]
+    _patch_evidence(monkeypatch, _evidence_file(
+        tmp_path, rows=[edited, unstamped]))
+    monkeypatch.delenv("GRACE_BENCH_RESUME", raising=False)
+    monkeypatch.setenv("GRACE_BENCH_RESUME_SINCE", "0")
+    assert not any("cached_row" in c for c in bench_all._resume_configs())
+    monkeypatch.setenv("GRACE_BENCH_RESUME", "1")
+    cached = {c["name"] for c in bench_all._resume_configs()
+              if "cached_row" in c}
+    assert cached == {"topk1pct_bs128"}   # unstamped ok ONLY when explicit
+
+
+def test_resume_since_rejects_stale_accepts_fresh(tmp_path, monkeypatch):
+    path = _evidence_file(tmp_path, rows=[_sweep_row("topk1pct_bs64",
+                                                     bs=64)])
+    _patch_evidence(monkeypatch, path)
+    monkeypatch.delenv("GRACE_BENCH_RESUME", raising=False)
+    # Watcher started an hour from now -> the file predates it: stale.
+    import time
+    monkeypatch.setenv("GRACE_BENCH_RESUME_SINCE", str(time.time() + 3600))
+    assert not any("cached_row" in c for c in bench_all._resume_configs())
+    monkeypatch.setenv("GRACE_BENCH_RESUME_SINCE", "0")
+    assert any("cached_row" in c for c in bench_all._resume_configs())
+
+
+def test_cached_row_passthrough_no_measurement():
+    # bench_configs must emit cached rows verbatim without building a model
+    # (a real build would compile ResNet-50 — the sub-second runtime of
+    # this test is itself the proof the passthrough short-circuits).
+    rows = []
+    cfg = {"name": "x", "params": {"compressor": "none"},
+           "cached_row": {"config": "x", "imgs_per_sec": 1.0,
+                          "resumed": True}}
+    # platform="cpu" under the test env (conftest pins the 8-dev CPU mesh).
+    bench.bench_configs("cpu", [cfg], rows.append)
+    assert rows == [{"config": "x", "imgs_per_sec": 1.0, "resumed": True}]

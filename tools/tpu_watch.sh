@@ -143,9 +143,10 @@ while true; do
       # and each row now brackets itself with interleaved dense samples.
       # Retry attempts resume: rows persisted by an earlier attempt are
       # re-emitted, not re-measured (a hung remote compile once burned 9
-      # already-measured rows). GRACE_BENCH_RESUME_SINCE (exported at
-      # watcher start, below the lock) lets bench_all reject evidence
-      # files older than this watcher run, so a stale last-week sweep
+      # already-measured rows). GRACE_BENCH_RESUME_SINCE (stamped at
+      # script start, before the single-instance lock; a losing
+      # invocation exits without using it) lets bench_all reject
+      # evidence files older than this watcher run, so a stale sweep
       # can never replay as fresh; GRACE_BENCH_RESUME remains the
       # operator's explicit this-file-is-fresh override.
       run_py 12000 python bench_all.py --_worker tpu
