@@ -259,6 +259,28 @@ def throughput(step, ts, batch, n_batches, warmup=2):
     return batch[1].shape[0] * n_batches / dt, ts
 
 
+def _cached_row_valid(cfg) -> bool:
+    """Last resume gate, evaluated where the platform is already pinned:
+    a raw params dict cannot express a *semantic default* change (round-4
+    case: use_pallas='auto' flipped from kernel-on to staged with no
+    params edit), so rows stamp the RESOLVED pallas mode and a cached row
+    is only replayed if the config still resolves the same way today.
+    Rows from before the stamp pass (nothing to compare)."""
+    row = cfg["cached_row"]
+    if "pallas_enabled" not in row:
+        return True
+    from grace_tpu import grace_from_params
+    comp = grace_from_params(cfg["params"]).compressor
+    mode = getattr(comp, "_pallas_mode", None)
+    now = bool(mode()[0]) if mode else False
+    if now == row["pallas_enabled"]:
+        return True
+    print(f"[bench] {cfg['name']}: cached row invalid "
+          f"(pallas_enabled {row['pallas_enabled']} -> {now}); re-measuring",
+          file=sys.stderr, flush=True)
+    return False
+
+
 def bench_configs(platform: str, configs, emit) -> None:
     """Measure each config's ResNet-50 training throughput; call
     ``emit(result_dict)`` once per config (first config = the dense
@@ -391,7 +413,7 @@ def bench_configs(platform: str, configs, emit) -> None:
     med = statistics.median
     for cfg in configs:
         name = cfg["name"]
-        if "cached_row" in cfg:
+        if "cached_row" in cfg and _cached_row_valid(cfg):
             # Resume support (bench_all GRACE_BENCH_RESUME): a row measured
             # earlier in this tunnel session is re-emitted instead of
             # re-burning the chip; it carries "resumed": true. configs[0]
@@ -451,6 +473,11 @@ def bench_configs(platform: str, configs, emit) -> None:
               + (f", mfu={mfu:.4f}" if mfu is not None else ""),
               file=sys.stderr, flush=True)
         row_extra = {"grace_params": cfg["params"]}
+        pmode = getattr(ent.grace.compressor, "_pallas_mode", None)
+        if pmode is not None:
+            # Resolved (not configured) kernel engagement — the resume
+            # gate compares this across semantic default changes.
+            row_extra["pallas_enabled"] = bool(pmode()[0])
         if cfg.get("note"):
             # Config-level caveat (e.g. "bf16 grads use the staged Top-K
             # path") — evidence rows must carry their own context.

@@ -33,16 +33,18 @@ CONFIGS = [
     # The headline pair (dense baseline first) comes verbatim from bench.py
     # so the two benchmarks can never drift apart.
     *bench.HEADLINE,
-    # Ablation: chunk selection WITHOUT the fused Pallas kernels
-    # (ops/pallas_topk.py) — quantifies the kernels' on-chip win vs the
-    # staged XLA path (headline row runs with use_pallas='auto' = on).
-    {"name": "topk1pct_nopallas", "params": {"compressor": "topk",
-                                             "compress_ratio": 0.01,
-                                             "topk_algorithm": "chunk",
-                                             "use_pallas": False,
-                                             "memory": "residual",
-                                             "communicator": "allgather",
-                                             "fusion": "flat"}},
+    # Ablation: chunk selection WITH the fused Pallas kernels forced on
+    # (ops/pallas_topk.py). The round-4 on-chip A/B measured the staged
+    # XLA path FASTER end-to-end (1602 vs 1441 imgs/sec at bs=32, same
+    # session), so 'auto' now resolves to staged and this row keeps the
+    # kernel measurable should a later change flip the verdict back.
+    {"name": "topk1pct_pallas", "params": {"compressor": "topk",
+                                           "compress_ratio": 0.01,
+                                           "topk_algorithm": "chunk",
+                                           "use_pallas": True,
+                                           "memory": "residual",
+                                           "communicator": "allgather",
+                                           "fusion": "flat"}},
     # Batch-size sweep (VERDICT round-3 item 4): at bs=32 the fixed ~10 ms
     # compression cost is ~45% of the step, so the headline choice works
     # *against* the >=0.90x target; these rows show where it amortizes and

@@ -28,9 +28,11 @@ class QSGDCompressor(Compressor):
     use_pallas: bool | str = False
 
     def __post_init__(self):
-        if not (self.use_pallas in ("auto", True, False)):
-            # A truthy string like 'off' would silently force the kernel ON
-            # through _pallas_mode's truthiness check.
+        # Identity membership, not ==: 1 == True would pass equality
+        # validation yet be treated differently by the `is True` checks
+        # below — accept exactly the three documented spellings.
+        if not (self.use_pallas == "auto" or self.use_pallas is True
+                or self.use_pallas is False):
             raise ValueError(f"use_pallas must be True, False or 'auto'; "
                              f"got {self.use_pallas!r}")
 
@@ -40,7 +42,7 @@ class QSGDCompressor(Compressor):
             return False, False
         if self.use_pallas == "auto":
             return jax.default_backend() == "tpu", False
-        if self.use_pallas:
+        if self.use_pallas is True:
             on_tpu = jax.default_backend() == "tpu"
             return True, not on_tpu
         return False, False

@@ -48,8 +48,13 @@ class TopKCompressor(Compressor):
     # Fused Pallas TPU kernel for the chunk-mode LOCAL pipeline (compensate
     # + select + value extract + residual update in one HBM pass — see
     # grace_tpu/ops/pallas_topk.py), used via the Communicator.step fast
-    # path with linear-error-feedback memories. 'auto': on for TPU, plain
-    # XLA elsewhere; True forces interpret mode off-TPU (tests).
+    # path with linear-error-feedback memories. 'auto' resolves to the
+    # staged XLA path everywhere: the on-chip A/B (BENCH_ALL_TPU_LAST.json
+    # 2026-07-31, same session) measured staged at 1602 vs fused-kernel
+    # 1441 imgs/sec on the ResNet-50 headline — XLA's own fusion beats the
+    # hand-written kernel end-to-end, so the kernel is an explicit opt-in
+    # (True; forces interpret mode off-TPU for tests) until a measurement
+    # says otherwise.
     use_pallas: bool | str = "auto"
 
     def __post_init__(self):
@@ -57,9 +62,11 @@ class TopKCompressor(Compressor):
             raise ValueError(f"unknown topk algorithm {self.algorithm!r}")
         if self.wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
-        if not (self.use_pallas in ("auto", True, False)):
-            # A truthy string like 'off' would silently force the kernel ON
-            # through _pallas_mode's truthiness check.
+        # Identity membership, not ==: 1 == True would pass equality
+        # validation yet fail the `is True` opt-in check in _pallas_mode,
+        # silently running staged — accept exactly the three spellings.
+        if not (self.use_pallas == "auto" or self.use_pallas is True
+                or self.use_pallas is False):
             raise ValueError(f"use_pallas must be True, False or 'auto'; "
                              f"got {self.use_pallas!r}")
 
@@ -67,11 +74,9 @@ class TopKCompressor(Compressor):
         from grace_tpu.ops import pallas_disabled
         if pallas_disabled(explicit=self.use_pallas is True, kernel="topk"):
             return False, False
-        if self.use_pallas == "auto":
-            return jax.default_backend() == "tpu", False
-        if self.use_pallas:
+        if self.use_pallas is True:
             return True, jax.default_backend() != "tpu"
-        return False, False
+        return False, False            # 'auto' == staged (measured faster)
 
     def _fused_chunk_gate(self, numel: int, dtype, world):
         """Shared guard for both fused fast paths. Returns (k, interpret)

@@ -199,3 +199,20 @@ def test_cached_row_passthrough_no_measurement():
     # platform="cpu" under the test env (conftest pins the 8-dev CPU mesh).
     bench.bench_configs("cpu", [cfg], rows.append)
     assert rows == [{"config": "x", "imgs_per_sec": 1.0, "resumed": True}]
+
+
+def test_cached_row_invalid_on_pallas_resolution_change():
+    # A row stamped pallas_enabled=True replays only if the config still
+    # resolves the kernel on today ('auto' resolves staged everywhere
+    # since round 4, so a kernel-measured row must re-measure).
+    params = {"compressor": "topk", "compress_ratio": 0.01,
+              "topk_algorithm": "chunk", "memory": "residual",
+              "communicator": "allgather", "fusion": "flat"}
+    cfg = {"name": "topk1pct", "params": params,
+           "cached_row": {"config": "topk1pct", "imgs_per_sec": 1.0,
+                          "pallas_enabled": True, "resumed": True}}
+    assert bench._cached_row_valid(cfg) is False
+    cfg["cached_row"]["pallas_enabled"] = False
+    assert bench._cached_row_valid(cfg) is True
+    del cfg["cached_row"]["pallas_enabled"]   # pre-stamp row: trusted
+    assert bench._cached_row_valid(cfg) is True

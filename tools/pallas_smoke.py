@@ -1,9 +1,10 @@
 """60-second on-chip smoke test for the Pallas kernels.
 
 tpu_watch.sh runs this right after a successful tunnel probe and BEFORE the
-benches: the fused chunk-Top-K kernel (ops/pallas_topk.py) is on the
-headline path (use_pallas='auto'), so a Mosaic compile failure on the real
-chip would otherwise crash every bench attempt. Per-kernel verdicts (round-4
+benches: the sweep's topk1pct_pallas / qsgd_pallas ablation rows force the
+Pallas kernels on (the headline 'auto' default resolves to the staged XLA
+path since the round-4 A/B), so a Mosaic compile failure on the real chip
+would otherwise crash every bench attempt. Per-kernel verdicts (round-4
 postmortem: a Mosaic cast failure in the *quant* kernel used to disable the
 headline *topk* kernels too, costing the whole fused-path measurement):
 
