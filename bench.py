@@ -259,20 +259,37 @@ def throughput(step, ts, batch, n_batches, warmup=2):
     return batch[1].shape[0] * n_batches / dt, ts
 
 
+def _resolved_pallas(compressor):
+    """RESOLVED kernel engagement for a built compressor: True/False for
+    kernel-capable compressors, None for the rest. The single source both
+    the row stamp and the resume gate use — they must never drift."""
+    mode = getattr(compressor, "_pallas_mode", None)
+    return bool(mode()[0]) if mode is not None else None
+
+
 def _cached_row_valid(cfg) -> bool:
     """Last resume gate, evaluated where the platform is already pinned:
     a raw params dict cannot express a *semantic default* change (round-4
     case: use_pallas='auto' flipped from kernel-on to staged with no
     params edit), so rows stamp the RESOLVED pallas mode and a cached row
     is only replayed if the config still resolves the same way today.
-    Rows from before the stamp pass (nothing to compare)."""
+    A kernel-capable config whose row predates the stamp fails CLOSED
+    (re-measures) unless the row carries resume_trusted — the explicit
+    operator override's assertion (the round-4 bs-sweep rows were
+    measured while 'auto' still meant kernel-on; nothing in them says
+    so)."""
     row = cfg["cached_row"]
-    if "pallas_enabled" not in row:
-        return True
     from grace_tpu import grace_from_params
-    comp = grace_from_params(cfg["params"]).compressor
-    mode = getattr(comp, "_pallas_mode", None)
-    now = bool(mode()[0]) if mode else False
+    now = _resolved_pallas(grace_from_params(cfg["params"]).compressor)
+    if now is None:       # not kernel-capable: nothing to compare
+        return True
+    if "pallas_enabled" not in row:
+        if row.get("resume_trusted"):
+            return True
+        print(f"[bench] {cfg['name']}: cached row predates the "
+              "pallas_enabled stamp; re-measuring",
+              file=sys.stderr, flush=True)
+        return False
     if now == row["pallas_enabled"]:
         return True
     print(f"[bench] {cfg['name']}: cached row invalid "
@@ -473,11 +490,11 @@ def bench_configs(platform: str, configs, emit) -> None:
               + (f", mfu={mfu:.4f}" if mfu is not None else ""),
               file=sys.stderr, flush=True)
         row_extra = {"grace_params": cfg["params"]}
-        pmode = getattr(ent.grace.compressor, "_pallas_mode", None)
-        if pmode is not None:
+        resolved = _resolved_pallas(ent.grace.compressor)
+        if resolved is not None:
             # Resolved (not configured) kernel engagement — the resume
             # gate compares this across semantic default changes.
-            row_extra["pallas_enabled"] = bool(pmode()[0])
+            row_extra["pallas_enabled"] = resolved
         if cfg.get("note"):
             # Config-level caveat (e.g. "bf16 grads use the staged Top-K
             # path") — evidence rows must carry their own context.

@@ -66,8 +66,18 @@ CONFIGS = [
     # the note rides the emitted row so the evidence says so.
     {"name": "topk1pct_bs128_pbf16", "per_device_bs": 128,
      "param_dtype": "bfloat16",
-     "note": "bf16 grads fall back to the staged chunk Top-K "
-             "(fused Pallas kernel is f32-only)",
+     "note": "bf16 grads take the staged chunk Top-K "
+             "(the Pallas kernel is f32-only; staged is the default "
+             "everywhere since round 4 anyway)",
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "communicator": "allgather", "fusion": "flat"}},
+    # Both amortization levers together: the headline batch AND bf16
+    # params — round-4 candidates for the best measured ratio.
+    {"name": "topk1pct_bs256_pbf16", "per_device_bs": 256,
+     "param_dtype": "bfloat16",
+     "note": "bf16 grads take the staged chunk Top-K "
+             "(the Pallas kernel is f32-only)",
      "params": {"compressor": "topk", "compress_ratio": 0.01,
                 "topk_algorithm": "chunk", "memory": "residual",
                 "communicator": "allgather", "fusion": "flat"}},
@@ -223,6 +233,12 @@ def _resume_configs():
         elif not explicit:
             continue
         cfg["cached_row"] = {**row, "resumed": True}
+        if explicit:
+            # The operator's assertion that this file is trustworthy also
+            # covers rows predating the pallas_enabled stamp — the
+            # bench-side gate (_cached_row_valid) fails closed on those
+            # otherwise.
+            cfg["cached_row"]["resume_trusted"] = True
     return configs
 
 

@@ -214,5 +214,16 @@ def test_cached_row_invalid_on_pallas_resolution_change():
     assert bench._cached_row_valid(cfg) is False
     cfg["cached_row"]["pallas_enabled"] = False
     assert bench._cached_row_valid(cfg) is True
-    del cfg["cached_row"]["pallas_enabled"]   # pre-stamp row: trusted
+    # Pre-stamp row on a kernel-capable config: fails CLOSED (the round-4
+    # bs-sweep rows were measured under the old kernel-on default and
+    # nothing in them says so) unless the operator override vouches.
+    del cfg["cached_row"]["pallas_enabled"]
+    assert bench._cached_row_valid(cfg) is False
+    cfg["cached_row"]["resume_trusted"] = True
     assert bench._cached_row_valid(cfg) is True
+    # Non-kernel-capable config (e.g. compressor none): nothing to compare.
+    cfg2 = {"name": "none", "params": {"compressor": "none",
+                                       "memory": "none",
+                                       "communicator": "allreduce"},
+            "cached_row": {"config": "none", "imgs_per_sec": 1.0}}
+    assert bench._cached_row_valid(cfg2) is True

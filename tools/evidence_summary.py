@@ -104,7 +104,11 @@ def build() -> str:
         parts.append("")
     variants = _load("TPU_VARIANTS.jsonl")
     if variants:
-        parts += _row_table(variants, "Top-K selection variants (TPU)")
+        parts += _row_table(
+            variants,
+            "Top-K selection variants (TPU) — SUPERSEDED: cross-session "
+            "ratios (the dense row here hit the tunnel-RTT trap); the "
+            "same-session sweep above is the quotable record")
         parts.append("")
     bert = _load("BENCH_BERT_TPU_LAST.json")
     if bert and bert.get("rows"):

@@ -149,7 +149,11 @@ while true; do
       # evidence files older than this watcher run, so a stale sweep
       # can never replay as fresh; GRACE_BENCH_RESUME remains the
       # operator's explicit this-file-is-fresh override.
-      run_py 12000 python bench_all.py --_worker tpu
+      # 15000s outer leash: must stay ABOVE bench_all's own
+      # WORKER_TIMEOUT_S (600s x n_configs, 22 configs in round 4) so
+      # the worker's per-config error isolation, not this SIGKILL, is
+      # what bounds a slow sweep.
+      run_py 15000 python bench_all.py --_worker tpu
       rc2=$?
       echo "=== sweep rc=$rc2" >> "$LOG"
       echo "=== $(date -u +%FT%TZ) bert/powersgd bench" >> "$LOG"
