@@ -281,15 +281,18 @@ def _cached_row_valid(cfg) -> bool:
     row = cfg["cached_row"]
     from grace_tpu import grace_from_params
     now = _resolved_pallas(grace_from_params(cfg["params"]).compressor)
-    if now is None:       # not kernel-capable: nothing to compare
-        return True
     if "pallas_enabled" not in row:
+        if now is None:   # never was kernel-capable: nothing to compare
+            return True
         if row.get("resume_trusted"):
             return True
         print(f"[bench] {cfg['name']}: cached row predates the "
               "pallas_enabled stamp; re-measuring",
               file=sys.stderr, flush=True)
         return False
+    # Stamped row: a now-missing capability (now is None) is itself a
+    # semantic change — fail closed rather than replay a kernel-measured
+    # number for a compressor that can no longer engage the kernel.
     if now == row["pallas_enabled"]:
         return True
     print(f"[bench] {cfg['name']}: cached row invalid "
@@ -437,7 +440,11 @@ def bench_configs(platform: str, configs, emit) -> None:
             # stays the dense-recipe anchor either way.
             print(f"[bench] {name}: cached row (resume)",
                   file=sys.stderr, flush=True)
-            emit(cfg["cached_row"])
+            # Strip gate-only metadata: resume_trusted is the operator's
+            # one-run assertion — persisting it would turn it into a
+            # durable trust token future resumes silently honor.
+            emit({k: v for k, v in cfg["cached_row"].items()
+                  if k != "resume_trusted"})
             continue
         bs = cfg.get("per_device_bs", default_bs)
         hw = cfg.get("image_hw", default_hw)

@@ -227,3 +227,18 @@ def test_cached_row_invalid_on_pallas_resolution_change():
                                        "communicator": "allreduce"},
             "cached_row": {"config": "none", "imgs_per_sec": 1.0}}
     assert bench._cached_row_valid(cfg2) is True
+
+
+def test_stamped_row_fails_closed_when_capability_gone(monkeypatch):
+    # A row stamped pallas_enabled=True for a config that no longer
+    # resolves any kernel capability (now=None) must re-measure.
+    class NoKernel:
+        compressor = object()      # no _pallas_mode attribute
+
+    cfg = {"name": "topk1pct", "params": {"compressor": "topk",
+                                          "compress_ratio": 0.01},
+           "cached_row": {"config": "topk1pct", "imgs_per_sec": 1.0,
+                          "pallas_enabled": True, "resume_trusted": True}}
+    monkeypatch.setattr("grace_tpu.grace_from_params",
+                        lambda params: NoKernel())
+    assert bench._cached_row_valid(cfg) is False

@@ -30,6 +30,16 @@ ITERS = 20
 ALLOW_CPU = False       # --allow-cpu: script self-test off-chip (tiny N)
 
 
+OUT_PATH = None         # --out: mirror every result line to this file
+
+
+def _report(line: str) -> None:
+    print(line, flush=True)
+    if OUT_PATH:
+        with open(OUT_PATH, "a") as f:
+            f.write(line + "\n")
+
+
 def timed(name, make_body, *args, carry0=None):
     """make_body(carry, *args) -> new carry (same shape/dtype as carry)."""
     import jax
@@ -57,7 +67,7 @@ def timed(name, make_body, *args, carry0=None):
     out = run(c0, *args)
     float(jax.tree.leaves(out)[0].reshape(-1)[0])
     dt = (time.perf_counter() - t0) / ITERS
-    print(f"{name:34s} {dt*1e3:8.3f} ms/iter", flush=True)
+    _report(f"{name:34s} {dt*1e3:8.3f} ms/iter")
 
 
 def main() -> None:
@@ -84,7 +94,7 @@ def main() -> None:
     vals0 = jnp.ones((k,), jnp.float32)
     wr0 = jnp.zeros((k,), jnp.int32)
 
-    print(f"n={N} k={k} rows={rows} iters={ITERS}", flush=True)
+    _report(f"n={N} k={k} rows={rows} iters={ITERS}")
 
     timed("carry add only (baseline)", lambda c: c)
     timed("elementwise add", lambda c: c + resid)
@@ -169,7 +179,7 @@ def main() -> None:
         per = max(1, N // n_leaves)
         shapes = [(per,)] * (n_leaves - 1) + [(N - per * (n_leaves - 1),)]
     total = sum(int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes)
-    print(f"resnet50 leaves={len(shapes)} total={total}", flush=True)
+    _report(f"resnet50 leaves={len(shapes)} total={total}")
     leaves = [jax.random.normal(jax.random.key(10 + j), s, jnp.float32)
               for j, s in enumerate(shapes)]
 
@@ -236,7 +246,7 @@ def main() -> None:
         t_out = fn(leaves)
         float(jax.tree.leaves(t_out)[0].reshape(-1)[0])
         dt = (time.perf_counter() - t0) / ITERS
-        print(f"{label:34s} {dt*1e3:8.3f} ms/iter", flush=True)
+        _report(f"{label:34s} {dt*1e3:8.3f} ms/iter")
 
 
 if __name__ == "__main__":
@@ -247,6 +257,13 @@ if __name__ == "__main__":
     ap.add_argument("--allow-cpu", action="store_true",
                     help="self-test the script off-chip (pair with small"
                          " --n; timings are meaningless)")
+    ap.add_argument("--out", default=None,
+                    help="also append every result line to this file "
+                         "(the watcher points it at TPU_MICRO.txt)")
     a = ap.parse_args()
     N, K, ITERS, ALLOW_CPU = a.n, max(1, a.n // 100), a.iters, a.allow_cpu
+    OUT_PATH = a.out
+    if OUT_PATH:
+        with open(OUT_PATH, "a") as f:
+            f.write(f"=== tpu_micro run {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
     main()

@@ -149,10 +149,11 @@ while true; do
       # evidence files older than this watcher run, so a stale sweep
       # can never replay as fresh; GRACE_BENCH_RESUME remains the
       # operator's explicit this-file-is-fresh override.
-      # 15000s outer leash: must stay ABOVE bench_all's own
-      # WORKER_TIMEOUT_S (600s x n_configs, 22 configs in round 4) so
-      # the worker's per-config error isolation, not this SIGKILL, is
-      # what bounds a slow sweep.
+      # 15000s outer leash — in --_worker mode this IS the only bound on
+      # a hung sweep (bench_all's WORKER_TIMEOUT_S applies to its
+      # orchestrate() subprocess path, not --_worker; the per-config
+      # try/except catches exceptions, not hangs). Sized above
+      # 600s x 22 configs so a merely slow sweep is never cut short.
       run_py 15000 python bench_all.py --_worker tpu
       rc2=$?
       echo "=== sweep rc=$rc2" >> "$LOG"
@@ -160,6 +161,29 @@ while true; do
       run_py 3600 python tools/tpu_bert_bench.py --platform tpu
       rc3=$?
       echo "=== bert rc=$rc3" >> "$LOG"
+      # Best-effort extras: a failure here logs but does NOT block
+      # retirement or trigger a whole-chain retry (a deterministic bug
+      # in an extra must not re-burn the chip for 5 full attempts).
+      # Only on the retiring attempt (sweep + bert both succeeded):
+      # retry loops must re-probe the failing stage promptly, not burn
+      # up to ~100 min of chip per attempt on extras that would be
+      # overwritten next attempt anyway.
+      if [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ]; then
+      echo "=== $(date -u +%FT%TZ) per-stage micro breakdown" >> "$LOG"
+      run_py 2400 python tools/tpu_micro.py --out TPU_MICRO.txt
+      echo "=== micro rc=$?" >> "$LOG"
+      echo "=== $(date -u +%FT%TZ) torch interop bucket A/B" >> "$LOG"
+      run_py 1800 sh -c 'python examples/torch_synthetic_benchmark.py \
+        --compressor topk --compress-ratio 0.01 --memory residual \
+        --num-iters 5 --bucket-cap-mb 32 \
+        > TORCH_INTEROP_TPU_bucketed.txt 2>&1'
+      rcb=$?
+      run_py 1800 sh -c 'python examples/torch_synthetic_benchmark.py \
+        --compressor topk --compress-ratio 0.01 --memory residual \
+        --num-iters 5 --bucket-cap-mb 0 \
+        > TORCH_INTEROP_TPU_single.txt 2>&1'
+      echo "=== interop rc=$rcb/$?" >> "$LOG"
+      fi
     fi
     resume_cpu_jobs
     # Only retire the watcher once ALL measurements actually landed —
