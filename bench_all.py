@@ -81,6 +81,15 @@ CONFIGS = [
      "params": {"compressor": "topk", "compress_ratio": 0.01,
                 "topk_algorithm": "chunk", "memory": "residual",
                 "communicator": "allgather", "fusion": "flat"}},
+    # bf16 RESIDUAL with f32 params (ResidualMemory state_dtype): halves
+    # the largest state tensor's HBM traffic without touching the model's
+    # numerics; the rounding rides the same feedback loop as the
+    # compression error.
+    {"name": "topk1pct_bs256_rbf16", "per_device_bs": 256,
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "memory_dtype": "bfloat16",
+                "communicator": "allgather", "fusion": "flat"}},
     # Two-shot scatter-reduce-recompress all-reduce: O(k) wire per rank vs
     # allgather's O(W·k) (see comm.TwoShotAllreduce); VERDICT round-2
     # item 5 asks for its on-chip stage-2 recompress overhead.

@@ -101,7 +101,8 @@ def _build_memory(params: Dict[str, Any], axis: str) -> Memory:
         return M.NoneMemory()
     if name == "residual":
         return M.ResidualMemory(beta=params.get("beta", 1.0),
-                                gamma=params.get("gamma", 1.0))
+                                gamma=params.get("gamma", 1.0),
+                                state_dtype=params.get("memory_dtype"))
     if name == "efsignsgd":
         return M.EFSignSGDMemory(lr=params.get("lr", 0.1))
     if name == "dgc":

@@ -36,10 +36,25 @@ class ResidualMemory(Memory):
     reference actually skips the γ scaling on the miss; with the default
     γ=1.0 the behaviors coincide, and for γ≠1 a uniformly-scaled first step
     is the saner semantics).
+
+    ``state_dtype`` (TPU-first extension, no reference analog): store the
+    residual in a narrower dtype than the gradients — ``'bfloat16'``
+    halves the largest per-step state tensor's HBM traffic (102 MB → 51 MB
+    on a fused ResNet-50 buffer). The rounding error this introduces goes
+    through the same feedback loop that already absorbs the compression
+    error (identical argument to Top-K's ``wire_dtype='bfloat16'``).
+    Compensate math still runs in the gradient dtype. A non-f32 state
+    automatically takes the staged pipeline (the fused Pallas gate
+    rejects it).
     """
 
     beta: float = 1.0
     gamma: float = 1.0
+    state_dtype: str | None = None   # None = gradient dtype
+
+    def __post_init__(self):
+        if self.state_dtype is not None:
+            jnp.dtype(self.state_dtype)   # fail fast on a typo
 
     @property
     def linear_feedback_coeffs(self):
@@ -49,14 +64,16 @@ class ResidualMemory(Memory):
         return (self.beta, self.gamma)
 
     def init_state(self, x: jax.Array) -> State:
-        return jnp.zeros_like(x)
+        dt = self.state_dtype or jnp.result_type(x)
+        return jnp.zeros(jnp.shape(x), dt)
 
     def compensate(self, x: jax.Array, state: State):
-        return self.beta * state + self.gamma * x, state
+        return self.beta * state.astype(x.dtype) + self.gamma * x, state
 
     def update(self, compensated: jax.Array, payload: Payload, ctx: Ctx,
                compressor: Compressor, state: State) -> State:
-        return compensated - compressor.decompress(payload, ctx)
+        resid = compensated - compressor.decompress(payload, ctx)
+        return resid.astype(state.dtype)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -83,3 +83,30 @@ def test_powersgd_memory_residual_2d(rng):
     st = mem.init_state(x)
     out, _ = mem.compensate(x, st)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_residual_state_dtype_bf16(rng):
+    """state_dtype='bfloat16' stores the residual narrow but computes the
+    compensate in the gradient dtype; feedback still accumulates."""
+    mem = M.ResidualMemory(state_dtype="bfloat16")
+    comp = C.TopKCompressor(compress_ratio=0.5)
+    x = jnp.asarray([10.0, 1.0, -8.0, 0.5], jnp.float32)
+    st = mem.init_state(x)
+    assert st.dtype == jnp.bfloat16
+    c, st = mem.compensate(x, st)
+    assert c.dtype == jnp.float32            # math in gradient dtype
+    payload, ctx, _ = comp.compress(c, None, KEY)
+    st = mem.update(c, payload, ctx, comp, st)
+    assert st.dtype == jnp.bfloat16
+    # bf16 holds these exactly: same residual as the f32 test
+    np.testing.assert_allclose(np.asarray(st, np.float32),
+                               [0.0, 1.0, 0.0, 0.5])
+    c2, _ = mem.compensate(jnp.zeros(4, jnp.float32), st)
+    assert c2.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(c2), [0.0, 1.0, 0.0, 0.5])
+
+
+def test_residual_state_dtype_typo_fails_fast():
+    import pytest
+    with pytest.raises(TypeError):
+        M.ResidualMemory(state_dtype="bfloat17")

@@ -264,6 +264,10 @@ if __name__ == "__main__":
     N, K, ITERS, ALLOW_CPU = a.n, max(1, a.n // 100), a.iters, a.allow_cpu
     OUT_PATH = a.out
     if OUT_PATH:
-        with open(OUT_PATH, "a") as f:
-            f.write(f"=== tpu_micro run {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
+        # Truncate: the file is single-run evidence; appending would let a
+        # consumer grep up a stale run's stage timing (the stale-evidence
+        # class GRACE_BENCH_RESUME_SINCE guards against elsewhere).
+        with open(OUT_PATH, "w") as f:
+            f.write(f"=== tpu_micro run "
+                    f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
     main()
