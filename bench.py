@@ -774,6 +774,41 @@ def load_tpu_evidence(path: str = TPU_EVIDENCE_PATH):
         return None
 
 
+SWEEP_SUMMARY_PATH = os.path.join(os.path.dirname(TPU_EVIDENCE_PATH),
+                                  "BENCH_ALL_TPU_LAST.json")
+
+
+def load_tpu_sweep_summary(path: str = SWEEP_SUMMARY_PATH):
+    """Trimmed view of the last on-TPU per-algorithm sweep, carried along
+    by fallback runs next to ``last_tpu``: the headline file alone can
+    understate the round (round-4 case: the bs=32 headline pair reads
+    0.56x while the same-session sweep holds the deliberately-chosen
+    bs=256 record at 0.92x). Row payloads are cut to the fields a reader
+    ranks configs by."""
+    doc = load_tpu_evidence(path)
+    if not doc or not doc.get("rows"):
+        return None
+    keep = ("config", "imgs_per_sec", "vs_baseline", "spread_pct",
+            "same_session", "per_device_bs", "param_dtype", "wire_ratio",
+            "mfu", "note", "resumed", "error")
+    return {"captured_at": doc.get("captured_at"),
+            "partial": doc.get("partial"),
+            "rows": [{k: r[k] for k in keep if k in r}
+                     for r in doc["rows"]]}
+
+
+def _attach_tpu_evidence(d: dict) -> None:
+    """Attach the latest persisted on-TPU records to a non-TPU result —
+    one helper for both the parse() and emit_failure() sites so the two
+    outputs can never drift."""
+    last = load_tpu_evidence()
+    if last:
+        d["last_tpu"] = last
+    sweep = load_tpu_sweep_summary()
+    if sweep:
+        d["last_tpu_sweep"] = sweep
+
+
 def main() -> None:
     here = os.path.abspath(__file__)
 
@@ -783,10 +818,8 @@ def main() -> None:
             result["stages"] = stages
             if result.get("platform") != "tpu":
                 # TPU evidence is written by the worker itself, row by row;
-                # a fallback run just carries the latest real number along.
-                last = load_tpu_evidence()
-                if last:
-                    result["last_tpu"] = last
+                # a fallback run just carries the latest real numbers along.
+                _attach_tpu_evidence(result)
             print(json.dumps(result), flush=True)
         return result
 
@@ -796,9 +829,7 @@ def main() -> None:
             "value": None, "unit": "imgs/sec", "vs_baseline": None,
             "stages": stages,
         }
-        last = load_tpu_evidence()
-        if last:
-            out["last_tpu"] = last
+        _attach_tpu_evidence(out)
         print(json.dumps(out), flush=True)
 
     if not orchestrate(here, parse, emit_failure):

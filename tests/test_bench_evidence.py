@@ -242,3 +242,24 @@ def test_stamped_row_fails_closed_when_capability_gone(monkeypatch):
     monkeypatch.setattr("grace_tpu.grace_from_params",
                         lambda params: NoKernel())
     assert bench._cached_row_valid(cfg) is False
+
+
+def test_sweep_summary_trims_rows(tmp_path):
+    # Fallback runs carry a trimmed sweep view; bulky fields (projection,
+    # samples, grace_params) must not ride along, error rows must.
+    big = {"metric": "m", "captured_at": "2026-07-31T19:04:30+00:00",
+           "partial": True,
+           "rows": [{"config": "topk1pct_bs256", "imgs_per_sec": 2114.1,
+                     "vs_baseline": 0.9246, "same_session": True,
+                     "per_device_bs": 256, "projection": [{"world": 8}],
+                     "samples": [1, 2, 3], "grace_params": {"x": 1}},
+                    {"config": "boom", "error": "died"}]}
+    p = tmp_path / "BENCH_ALL_TPU_LAST.json"
+    p.write_text(json.dumps(big))
+    s = bench.load_tpu_sweep_summary(str(p))
+    assert s["partial"] is True
+    assert s["rows"][0]["vs_baseline"] == 0.9246
+    assert "projection" not in s["rows"][0]
+    assert "samples" not in s["rows"][0]
+    assert "grace_params" not in s["rows"][0]
+    assert s["rows"][1] == {"config": "boom", "error": "died"}

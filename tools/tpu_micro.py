@@ -36,7 +36,11 @@ OUT_PATH = None         # --out: mirror every result line to this file
 def _report(line: str) -> None:
     print(line, flush=True)
     if OUT_PATH:
-        with open(OUT_PATH, "a") as f:
+        # Lines accumulate in a .tmp sibling; __main__ os.replace()s it
+        # over OUT_PATH only after a COMPLETE run, so a crashed/timed-out
+        # run can neither clobber the previous complete breakdown nor
+        # leave a fresh-stamped partial that reads as authoritative.
+        with open(OUT_PATH + ".tmp", "a") as f:
             f.write(line + "\n")
 
 
@@ -264,10 +268,9 @@ if __name__ == "__main__":
     N, K, ITERS, ALLOW_CPU = a.n, max(1, a.n // 100), a.iters, a.allow_cpu
     OUT_PATH = a.out
     if OUT_PATH:
-        # Truncate: the file is single-run evidence; appending would let a
-        # consumer grep up a stale run's stage timing (the stale-evidence
-        # class GRACE_BENCH_RESUME_SINCE guards against elsewhere).
-        with open(OUT_PATH, "w") as f:
+        with open(OUT_PATH + ".tmp", "w") as f:
             f.write(f"=== tpu_micro run "
                     f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
     main()
+    if OUT_PATH:
+        os.replace(OUT_PATH + ".tmp", OUT_PATH)
