@@ -446,8 +446,12 @@ def bench_configs(platform: str, configs, emit) -> None:
             emit({k: v for k, v in cfg["cached_row"].items()
                   if k != "resume_trusted"})
             continue
-        bs = cfg.get("per_device_bs", default_bs)
-        hw = cfg.get("image_hw", default_hw)
+        # Shape overrides are TPU-tuning knobs (the bs=256 headline would
+        # be a 2048-image step on the one-core CPU fallback and time the
+        # whole worker out); the CPU smoke keeps its tiny shapes and rows
+        # always stamp the bs/hw they actually ran.
+        bs = cfg.get("per_device_bs", default_bs) if on_tpu else default_bs
+        hw = cfg.get("image_hw", default_hw) if on_tpu else default_hw
         pdtype = cfg.get("param_dtype", "float32")
         try:
             base = baseline_for(bs, hw, pdtype)
