@@ -130,9 +130,8 @@ def main():
     prov = run_provenance(
         data=f"real:{args.data_dir}" if args.data_dir else "synthetic",
         recipe="cifar10_dawn 24-epoch DAWNBench",
-        compressor=args.compressor, memory=args.memory,
-        communicator=args.communicator, epochs=args.epochs,
-        batch_size=args.batch_size)
+        epochs=args.epochs, batch_size=args.batch_size,
+        **common.grace_provenance(args))
     table, tsv = TableLogger(), TSVLogger(provenance=prov)
     timer = Timer()
     for epoch in range(1, args.epochs + 1):

@@ -56,7 +56,8 @@ def add_grace_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--memory", default="none",
                    help="none|residual|efsignsgd|dgc|powersgd")
     g.add_argument("--communicator", default="allgather",
-                   help="allreduce|allgather|broadcast|identity")
+                   help="allreduce|allgather|broadcast|sign_allreduce|"
+                        "twoshot|identity")
     g.add_argument("--compress-ratio", type=float, default=0.01)
     g.add_argument("--quantum-num", type=int, default=64)
     g.add_argument("--threshold", type=float, default=0.01)
@@ -72,7 +73,12 @@ def add_grace_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--use-pallas", default="auto",
                    choices=["auto", "on", "off"],
                    help="fused Pallas kernels (qsgd quantize, chunk top-k "
-                        "local pipeline): auto = on for TPU only")
+                        "local pipeline): auto = each compressor's default "
+                        "(staged since round 4's on-chip A/B); on = force")
+    g.add_argument("--memory-dtype", default=None,
+                   help="storage dtype for the residual memory state "
+                        "(e.g. bfloat16 halves its HBM traffic; round-4 "
+                        "grace-tpu extension, ResidualMemory.state_dtype)")
     g.add_argument("--seed", type=int, default=42)
 
 
@@ -101,7 +107,29 @@ def grace_params_from_args(args) -> dict:
     # evidence — flipping it from a CLI default would bypass that gate).
     if args.use_pallas != "auto":
         params["use_pallas"] = args.use_pallas == "on"
+    if getattr(args, "memory_dtype", None):
+        if args.memory != "residual":
+            # Fail fast like the library does for a bad dtype string: the
+            # knob only exists on ResidualMemory, and a silently ignored
+            # flag would leave the operator believing the state is narrow.
+            raise SystemExit(
+                f"--memory-dtype applies only to --memory residual "
+                f"(got --memory {args.memory})")
+        params["memory_dtype"] = args.memory_dtype
     return params
+
+
+def grace_provenance(args) -> dict:
+    """The grace-config fields every curve evidence file must carry —
+    one place, so a new curve-affecting knob (round-4 case:
+    --memory-dtype) cannot be added without its provenance stamp."""
+    prov = {"compressor": args.compressor, "memory": args.memory,
+            "communicator": args.communicator}
+    if getattr(args, "memory_dtype", None):
+        prov["memory_dtype"] = args.memory_dtype
+    if args.compressor == "topk":
+        prov["topk_algorithm"] = args.topk_algorithm
+    return prov
 
 
 # ---------------------------------------------------------------------------

@@ -101,9 +101,8 @@ def run(argv=None):
     if args.tsv:
         os.makedirs(os.path.dirname(args.tsv) or ".", exist_ok=True)
         # Self-describing evidence: data source + platform in the file.
-        prov = run_provenance(data="real:sklearn-uci-digits", compressor=args.compressor,
-                              memory=args.memory,
-                              communicator=args.communicator)
+        prov = run_provenance(data="real:sklearn-uci-digits",
+                              **common.grace_provenance(args))
         with open(args.tsv, "w") as f:
             f.write("\n".join([f"# {k}: {v}" for k, v in prov.items()]
                               + rows) + "\n")

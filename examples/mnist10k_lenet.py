@@ -116,8 +116,7 @@ def run(argv=None):
         os.makedirs(os.path.dirname(args.tsv) or ".", exist_ok=True)
         # Self-describing evidence: data source + platform in the file.
         prov = run_provenance(data=f"real:mnist({args.data_dir})",
-                              compressor=args.compressor, memory=args.memory,
-                              communicator=args.communicator)
+                              **common.grace_provenance(args))
         with open(args.tsv, "w") as f:
             f.write("\n".join([f"# {k}: {v}" for k, v in prov.items()]
                               + rows) + "\n")
