@@ -30,7 +30,8 @@ import optax
 import common  # noqa: E402 — sys.path bootstrap so grace_tpu imports resolve
 from grace_tpu import grace_from_params
 from grace_tpu.models import lenet
-from grace_tpu.parallel import batch_sharded, data_parallel_mesh
+from grace_tpu.data import prefetch_to_device
+from grace_tpu.parallel import data_parallel_mesh
 from grace_tpu.train import (init_stateful_train_state,
                              make_stateful_train_step)
 from grace_tpu.utils import (TableLogger, Timer, rank_zero_print,
@@ -95,10 +96,11 @@ def run(argv=None):
     test_acc = 0.0
     for epoch in range(1, args.epochs + 1):
         losses = []
-        for xb, yb in common.batches(x_train, y_train, args.batch_size,
-                                     shuffle=True, seed=args.seed + epoch):
-            batch = jax.device_put((jnp.asarray(xb), jnp.asarray(yb)),
-                                   batch_sharded(mesh))
+        host_batches = common.batches(x_train, y_train, args.batch_size,
+                                      shuffle=True, seed=args.seed + epoch)
+        # Device-side double buffering: batch t+1's host->HBM transfer is
+        # in flight while step t computes (grace_tpu.data.prefetch_to_device).
+        for batch in prefetch_to_device(host_batches, mesh=mesh, size=2):
             ts, loss = step(ts, batch)
             # Per-step host sync: this epoch enqueues ~60 steps, and on a
             # host with fewer cores than mesh devices an unbounded queue of

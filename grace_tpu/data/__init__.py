@@ -32,7 +32,7 @@ import numpy as np
 
 __all__ = ["MemoryDataset", "NativeLoader", "PythonLoader", "make_loader",
            "native_library_path", "mnist_dataset", "mnist_split_dataset",
-           "cifar10_dataset", "digits_dataset"]
+           "cifar10_dataset", "digits_dataset", "prefetch_to_device"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -313,3 +313,60 @@ def make_loader(dataset: MemoryDataset, batch_size: int,
         except (OSError, RuntimeError):
             pass
     return PythonLoader(dataset, batch_size, **kwargs)
+
+
+def prefetch_to_device(iterator, mesh=None, size: int = 2, sharding=None):
+    """Device-side double buffering over a host batch iterator.
+
+    The loaders above overlap *assembly* (disk/normalize/shuffle) with the
+    step; this overlaps the host→HBM *transfer* too: each batch is
+    ``jax.device_put`` with the batch-sharded layout ``size`` steps ahead,
+    so while step t computes, batches t+1..t+size are already in flight
+    (jax transfers are asynchronous — holding references to the
+    already-put batches is all the machinery needed; the flax
+    ``prefetch_to_device`` pattern, made mesh-aware). The reference's
+    analog is torch DataLoader ``pin_memory`` + async ``.cuda()``
+    (examples/torch/pytorch_mnist.py:63-70).
+
+    ``iterator`` yields batch pytrees (e.g. ``(x, y)`` numpy arrays with
+    a leading batch dim divisible by the mesh's data axis). Pass either a
+    ``mesh`` (layout = ``batch_sharded(mesh)``) or an explicit
+    ``sharding``. ``size=2`` is the classic setting: one batch computing,
+    one in flight. Argument validation is eager (this is a plain function
+    returning a generator), so a forgotten mesh fails at the call site,
+    not at the first pull inside the training loop.
+    """
+    from grace_tpu.parallel import batch_sharded
+
+    if sharding is None:
+        if mesh is None:
+            raise ValueError("prefetch_to_device needs a mesh or a sharding")
+        sharding = batch_sharded(mesh)
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+    return _prefetch_gen(iterator, sharding, size)
+
+
+def _prefetch_gen(iterator, sharding, size: int):
+    import collections
+
+    import jax
+
+    queue = collections.deque()
+    it = iter(iterator)
+
+    def _put_next() -> bool:
+        try:
+            batch = next(it)
+        except StopIteration:
+            return False
+        queue.append(jax.device_put(batch, sharding))
+        return True
+
+    for _ in range(size):
+        if not _put_next():
+            break
+    while queue:
+        out = queue.popleft()
+        _put_next()
+        yield out

@@ -222,3 +222,40 @@ class TestDatasetValidation:
         ds = mnist_dataset(str(tmp_path), train=False)
         assert ds.images.shape == (6, 28, 28, 1)
         np.testing.assert_array_equal(ds.labels, labels.astype(np.int32))
+
+
+class TestPrefetchToDevice:
+    def test_order_values_and_sharding(self):
+        import jax
+        from grace_tpu.data import prefetch_to_device
+        from grace_tpu.parallel import batch_sharded, data_parallel_mesh
+
+        mesh = data_parallel_mesh()
+        n_dev = len(jax.devices())
+        batches = [(np.full((2 * n_dev, 3), i, np.float32),
+                    np.arange(2 * n_dev, dtype=np.int32) + i)
+                   for i in range(5)]
+        out = list(prefetch_to_device(iter(batches), mesh=mesh, size=2))
+        assert len(out) == 5
+        want = batch_sharded(mesh)
+        for i, (x, y) in enumerate(out):
+            assert x.sharding.is_equivalent_to(want, x.ndim)
+            np.testing.assert_array_equal(np.asarray(x), batches[i][0])
+            np.testing.assert_array_equal(np.asarray(y), batches[i][1])
+
+    def test_short_and_empty_iterators(self):
+        from grace_tpu.data import prefetch_to_device
+        from grace_tpu.parallel import data_parallel_mesh
+        import jax
+        mesh = data_parallel_mesh()
+        n = len(jax.devices())
+        one = [(np.zeros((n, 1), np.float32),)]
+        assert len(list(prefetch_to_device(iter(one), mesh=mesh,
+                                           size=4))) == 1
+        assert list(prefetch_to_device(iter([]), mesh=mesh)) == []
+
+    def test_requires_mesh_or_sharding(self):
+        import pytest
+        from grace_tpu.data import prefetch_to_device
+        with pytest.raises(ValueError):
+            list(prefetch_to_device(iter([])))
