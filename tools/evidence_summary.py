@@ -83,6 +83,55 @@ def _row_table(rows, title, value_key="imgs_per_sec",
     return out
 
 
+def _curve_table():
+    """Final-accuracy table over every committed curve TSV in
+    examples/logs, read from each file's own provenance header (data
+    source, config) and last data row — the files self-describe, so this
+    can never quote a number the file does not contain."""
+    import glob
+
+    logs = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "logs", "*.tsv")))
+    rows = []
+    for path in logs:
+        prov, header, last = {}, None, None
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if line.startswith("# ") and ": " in line:
+                        k, v = line[2:].split(": ", 1)
+                        prov[k] = v
+                    elif line and header is None:
+                        header = line.split("\t")
+                    elif line:
+                        last = line.split("\t")
+        except OSError:
+            continue
+        if not header or not last:
+            continue
+        rec = dict(zip(header, last))
+        acc = rec.get("test_acc") or rec.get("top1Accuracy")
+        data = prov.get("data", "?").split(" (")[0]   # drop inline caveats
+        rows.append((os.path.basename(path), data,
+                     prov.get("compressor", "?"), prov.get("memory", "?"),
+                     prov.get("memory_dtype", ""),
+                     prov.get("communicator", "?"),
+                     rec.get("epoch", "?"), acc if acc is not None else "?"))
+    if not rows:
+        return []
+    out = ["**Convergence curves (examples/logs — final row of each "
+           "committed TSV; provenance from the file's own header)**", "",
+           "| file | data | compressor | memory | communicator | epochs |"
+           " final acc |", "|---|---|---|---|---|---|---|"]
+    for (name, data, comp, mem, mdt, comm, ep, acc) in rows:
+        mem_s = f"{mem}({mdt})" if mdt else mem
+        out.append(f"| {name} | {data} | {comp} | {mem_s} | {comm} |"
+                   f" {ep} | {acc} |")
+    return out
+
+
 def build() -> str:
     parts = []
     head = _load("BENCH_TPU_LAST.json")
@@ -132,6 +181,10 @@ def build() -> str:
                          f"{p['step_ms_ici']} | "
                          f"{p['speedup_vs_dense_ici']} | "
                          f"{p['speedup_vs_dense_dcn']} |")
+        parts.append("")
+    curves = _curve_table()
+    if curves:
+        parts += curves
         parts.append("")
     cpu = _load("BENCH_ALL_CPU.json")
     if isinstance(cpu, list):
