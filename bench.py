@@ -433,7 +433,17 @@ def bench_configs(platform: str, configs, emit) -> None:
     med = statistics.median
     for cfg in configs:
         name = cfg["name"]
-        if "cached_row" in cfg and _cached_row_valid(cfg):
+        cached_ok = "cached_row" in cfg and _cached_row_valid(cfg)
+        if not cached_ok and cfg.get("tpu_only") and not on_tpu:
+            # e.g. forced-Pallas rows: interpret mode off-TPU runs a
+            # per-element emulation (>45 min/config observed) and the
+            # number would mean nothing anyway. A valid cached row wins:
+            # a CPU-fallback resume must re-emit a real on-chip
+            # measurement, not replace it with a skip row.
+            emit({"config": name, "skipped": "tpu_only",
+                  "platform": devices[0].platform})
+            continue
+        if cached_ok:
             # Resume support (bench_all GRACE_BENCH_RESUME): a row measured
             # earlier in this tunnel session is re-emitted instead of
             # re-burning the chip; it carries "resumed": true. configs[0]

@@ -188,12 +188,16 @@ def build() -> str:
         parts.append("")
     cpu = _load("BENCH_ALL_CPU.json")
     if isinstance(cpu, list):
-        data_rows = [r for r in cpu if r.get("config")]
+        data_rows = [r for r in cpu
+                     if r.get("config") and r.get("imgs_per_sec")]
+        skipped = [r["config"] for r in cpu if r.get("skipped")]
         if data_rows:
+            skip_s = (f"; skipped on cpu: {', '.join(skipped)}"
+                      if skipped else "")
             parts.append(
-                f"CPU-mesh smoke sweep: {len(data_rows)} configs in "
-                "`BENCH_ALL_CPU.json` (throughput ratios are host-bound "
-                "artifacts; the wire columns are the content).")
+                f"CPU-mesh smoke sweep: {len(data_rows)} configs measured "
+                "in `BENCH_ALL_CPU.json` (throughput ratios are host-bound "
+                f"artifacts; the wire columns are the content{skip_s}).")
     return "\n".join(parts).rstrip() + "\n"
 
 
