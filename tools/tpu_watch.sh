@@ -1,6 +1,12 @@
 #!/bin/bash
 # TPU tunnel watcher (round-2 postmortem: the tunnel to the single real chip
 # goes down for hours at a stretch — backend init hangs rather than erroring).
+#
+# LAUNCH THIS FIRST THING IN A ROUND. Observed pattern (rounds 3 AND 4):
+# the tunnel is alive at round start (the driver just ran benches on it)
+# and dies within ~30 min, then stays dead for many hours (round 4:
+# alive 18:44-19:13, dead for the following 10+ h). The first half hour
+# of a round is most of the chip time you will get.
 # Probe on a schedule; on first success run the headline dense-vs-compressed
 # pair, then the full per-algorithm sweep, directly in TPU worker mode.
 # Evidence lands incrementally in BENCH_TPU_LAST.json / BENCH_ALL_TPU_LAST.json
