@@ -35,6 +35,15 @@ PROBE_TIMEOUTS_S = (180, 420)  # healthy tunnel inits in seconds; second
                                # probe gets a long leash for slow cold init
 WORKER_TIMEOUT_S = 1200        # full bench incl. first compile (~20-40s/fn)
 
+# On-TPU default measurement shapes (the reference protocol's bs=32 at
+# ImageNet 224²). Single source for bench_configs AND bench_all's resume
+# shape-match gate (_resume_configs) — duplicated literals once drifted
+# risk: a silent mismatch would re-measure (safe) but a collision with old
+# rows could replay a wrong-shape row (ADVICE r4).
+TPU_DEFAULT_BS = 32
+TPU_DEFAULT_HW = 224
+TPU_DEFAULT_PDTYPE = "float32"
+
 HEADLINE = [
     # Both sides get the fusion buffer — Horovod fuses the uncompressed
     # baseline too, so a like-for-like ratio must as well.
@@ -175,6 +184,30 @@ def setup_platform(platform: str):
 ICI_RING_BYTES_PER_S = 9.0e10
 DCN_BYTES_PER_S = 2.5e10
 PROJECTION_WORLDS = (8, 16, 64, 256)
+
+# Stamped ONCE per evidence document (_write_evidence) and once in the
+# headline JSON line so the numbers carry their own assumptions (VERDICT r4
+# item 5: "projections are quoted in every row — they must survive
+# scrutiny") without duplicating ~1.2 KB of prose into all 26 sweep rows.
+PROJECTION_MODEL = {
+    "ici_bytes_per_s": ICI_RING_BYTES_PER_S,
+    "dcn_bytes_per_s": DCN_BYTES_PER_S,
+    "constants_source": (
+        "TPU v5e: 4 ICI links/chip in a 2D torus, ~45 GB/s per direction "
+        "per link (cloud.google.com/tpu/docs/system-architecture-tpu-vm; "
+        "jax-ml.github.io/scaling-book/ 'TPU networking'); a 1-D ring "
+        "collective rides 2 links -> ~90 GB/s per chip. DCN ~25 GB/s/host "
+        "(scaling-book cross-slice figure)."),
+    "assumption": (
+        "NO-OVERLAP upper bound on wire cost: projected_step = "
+        "measured_single_chip_step + recv_bytes/bandwidth. Real XLA "
+        "overlaps collectives with compute, so absolute step times are "
+        "pessimistic for BOTH sides of the speedup ratio; dense (whose "
+        "allreduce overlaps the backward pass) benefits from overlap more "
+        "than compressed (whose gather waits on compress), so "
+        "speedup_vs_dense is an OPTIMISTIC bound for compression wherever "
+        "wire dominates and both get pessimistic step times."),
+}
 
 
 def recv_bytes_model(comm, vote: bool, payload_b: int, n_elems: int,
@@ -360,8 +393,8 @@ def bench_configs(platform: str, configs, emit) -> None:
     # Reference protocol: bs=32 per worker, ImageNet shapes on accelerators;
     # the CPU fallback shrinks shapes so a number lands anywhere. Configs
     # may override per_device_bs / image_hw / param_dtype (bs sweep).
-    default_bs = 32 if on_tpu else 4
-    default_hw = 224 if on_tpu else 64
+    default_bs = TPU_DEFAULT_BS if on_tpu else 4
+    default_hw = TPU_DEFAULT_HW if on_tpu else 64
     repeats = 3 if on_tpu else 1
     num_classes = 1000
 
@@ -462,7 +495,7 @@ def bench_configs(platform: str, configs, emit) -> None:
         # always stamp the bs/hw they actually ran.
         bs = cfg.get("per_device_bs", default_bs) if on_tpu else default_bs
         hw = cfg.get("image_hw", default_hw) if on_tpu else default_hw
-        pdtype = cfg.get("param_dtype", "float32")
+        pdtype = cfg.get("param_dtype", TPU_DEFAULT_PDTYPE)
         try:
             base = baseline_for(bs, hw, pdtype)
             if cfg["params"] == configs[0]["params"]:
@@ -598,6 +631,7 @@ def _worker(platform: str) -> None:
         "mfu": compressed.get("mfu"),
         "mfu_dense": results[0].get("mfu"),
         "projection": compressed.get("projection"),
+        "projection_model": PROJECTION_MODEL,
     }), flush=True)
 
 
@@ -726,6 +760,10 @@ def _write_evidence(rows: list, path: str, metric: str, n_expected: int,
         "rows_measured": len(rows),
         "rows_expected": n_expected,
         "rows": rows,
+        # Document-level stamp (not per-row: 26 identical copies of ~1.2 KB
+        # of prose would bloat every sweep file and the trimmed summary
+        # drops per-row fields anyway).
+        "projection_model": PROJECTION_MODEL,
         "captured_at": datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds"),
     }
@@ -773,7 +811,11 @@ def progressive_emit(emit, n_expected: int,
     def wrapped(r):
         rows.append(r)
         emit(r)
-        if r.get("platform") == "tpu":
+        # evidence_path=None disables persistence entirely: a CPU worker
+        # re-emitting cached platform-'tpu' rows (explicit operator resume)
+        # must never rewrite the TPU evidence file with a fresh captured_at
+        # over a rows list mixing CPU-measured rows (ADVICE r4).
+        if r.get("platform") == "tpu" and evidence_path:
             _write_evidence(rows, evidence_path, metric, n_expected,
                             headline_config, value_key)
 

@@ -33,42 +33,38 @@ CONFIGS = [
     # The headline pair (dense baseline first) comes verbatim from bench.py
     # so the two benchmarks can never drift apart.
     *bench.HEADLINE,
-    # Ablation: chunk selection WITH the fused Pallas kernels forced on
-    # (ops/pallas_topk.py). The round-4 on-chip A/B measured the staged
-    # XLA path FASTER end-to-end (1602 vs 1441 imgs/sec at bs=32, same
-    # session), so 'auto' now resolves to staged and this row keeps the
-    # kernel measurable should a later change flip the verdict back.
-    {"name": "topk1pct_pallas", "params": {"compressor": "topk",
-                                           "compress_ratio": 0.01,
-                                           "topk_algorithm": "chunk",
-                                           "use_pallas": True,
-                                           "memory": "residual",
-                                           "communicator": "allgather",
-                                           "fusion": "flat"}},
-    # Batch-size sweep (VERDICT round-3 item 4): at bs=32 the fixed ~10 ms
-    # compression cost is ~45% of the step, so the headline choice works
-    # *against* the >=0.90x target; these rows show where it amortizes and
-    # what dense MFU a throughput-tuned batch reaches. bench_configs
-    # re-measures the dense baseline at each row's own shapes (the row
-    # carries baseline_imgs_per_sec), so vs_baseline stays like-for-like.
-    # bs=256 may OOM on a 16 GB v5e — the sweep emits an error row and
-    # continues (bench_configs error isolation).
-    *[{"name": f"topk1pct_bs{bs}", "per_device_bs": bs,
-       "params": {"compressor": "topk", "compress_ratio": 0.01,
-                  "topk_algorithm": "chunk", "memory": "residual",
-                  "communicator": "allgather", "fusion": "flat"}}
-      for bs in (64, 128, 256)],
-    # bf16 master params at the amortizing batch: halves HBM traffic for
-    # params/grads/residual; MXU was already bf16 (activations cast). NOTE
-    # the fused Pallas Top-K kernel is f32-only (compressors/topk.py fused
-    # gate) so bf16 grads take the STAGED chunk path — this row's delta vs
-    # topk1pct_bs128 mixes the dtype change with that implementation swap;
-    # the note rides the emitted row so the evidence says so.
-    {"name": "topk1pct_bs128_pbf16", "per_device_bs": 128,
+    # ---- Round-5 priority block (VERDICT r4 items 1+3): the rows the
+    # analysis needs most, placed right after the headline pair because
+    # the tunnel historically dies mid-sweep and only the prefix lands. --
+    #
+    # THE beat-dense candidates (VERDICT r4 item 1): two-shot keeps recv
+    # ~O(k) flat in W (vs allgather's O(W·k) and dense's 2·n), so at the
+    # amortizing batch its multi-chip projection is the one config with a
+    # shot at speedup_vs_dense > 1 on DCN. Round 4 only measured twoshot
+    # at bs=32 (0.53x, fixed-overhead-dominated).
+    {"name": "topk1pct_twoshot_bs256", "per_device_bs": 256,
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "communicator": "twoshot", "fusion": "flat"}},
+    # + bf16 residual state: the cheapest HBM lever that doesn't touch
+    # model numerics (rounding rides the error-feedback loop).
+    {"name": "topk1pct_twoshot_bs256_rbf16", "per_device_bs": 256,
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "memory_dtype": "bfloat16",
+                "communicator": "twoshot", "fusion": "flat"}},
+    # Both levers: bf16 params AND bf16 residual on the twoshot wire.
+    {"name": "topk1pct_twoshot_bs256_pbf16_rbf16", "per_device_bs": 256,
      "param_dtype": "bfloat16",
      "note": "bf16 grads take the staged chunk Top-K "
-             "(the Pallas kernel is f32-only; staged is the default "
-             "everywhere since round 4 anyway)",
+             "(the Pallas kernel is f32-only)",
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "memory_dtype": "bfloat16",
+                "communicator": "twoshot", "fusion": "flat"}},
+    # Re-capture of the round-4 best measured row (0.9246x, spread 0.05%)
+    # plus its bf16 variants — never measured in round 4 (dead rows).
+    {"name": "topk1pct_bs256", "per_device_bs": 256,
      "params": {"compressor": "topk", "compress_ratio": 0.01,
                 "topk_algorithm": "chunk", "memory": "residual",
                 "communicator": "allgather", "fusion": "flat"}},
@@ -90,22 +86,55 @@ CONFIGS = [
                 "topk_algorithm": "chunk", "memory": "residual",
                 "memory_dtype": "bfloat16",
                 "communicator": "allgather", "fusion": "flat"}},
-    # Two-shot scatter-reduce-recompress all-reduce: O(k) wire per rank vs
-    # allgather's O(W·k) (see comm.TwoShotAllreduce); VERDICT round-2
-    # item 5 asks for its on-chip stage-2 recompress overhead.
+    # qsgd vs qsgd_pallas: THE evidence gate for flipping QSGD's
+    # use_pallas default (VERDICT r3 item 5, two rounds dark).
+    {"name": "qsgd",       "params": {"compressor": "qsgd",
+                                      "quantum_num": 64,
+                                      "memory": "none",
+                                      "communicator": "allgather",
+                                      "fusion": "flat"}},
+    # tpu_only: off-TPU this forces the quant kernel into interpret mode
+    # over the full 25.5M-param model — observed >45 min for ONE config on
+    # the CPU smoke (interpret Pallas is a per-element emulation); the
+    # kernel's off-TPU correctness is covered at small sizes by
+    # tests/test_pallas_quant.py, and the row only means anything on-chip.
+    {"name": "qsgd_pallas", "tpu_only": True,
+     "params": {"compressor": "qsgd",
+                "quantum_num": 64,
+                "use_pallas": True,
+                "memory": "none",
+                "communicator": "allgather",
+                "fusion": "flat"}},
+    {"name": "powersgd_r4", "params": {"compressor": "powersgd",
+                                       "compress_rank": 4,
+                                       "memory": "powersgd",
+                                       "communicator": "allreduce",
+                                       "fusion": "none"}},
+    # Fixed-cost psum majority vote (~4n bf16 on the wire, W-independent):
+    # the pod-scale route for sign methods, next to the packed allgather
+    # row below (also VERDICT round-2 item 5). Errored mid-remote-compile
+    # in round 4 when the tunnel dropped — verify the retry lands.
+    {"name": "signsgd_vote", "params": {"compressor": "signsgd",
+                                        "memory": "none",
+                                        "communicator": "sign_allreduce",
+                                        "fusion": "flat"}},
+    {"name": "onebit",     "params": {"compressor": "onebit",
+                                      "memory": "residual",
+                                      "communicator": "allgather",
+                                      "fusion": "flat"}},
+    {"name": "terngrad",   "params": {"compressor": "terngrad",
+                                      "memory": "none",
+                                      "communicator": "allgather",
+                                      "fusion": "flat"}},
+    # ---- end priority block ----
+    # Two-shot scatter-reduce-recompress all-reduce at the small batch:
+    # isolates the stage-2 recompress overhead (VERDICT round-2 item 5).
     {"name": "topk1pct_twoshot", "params": {"compressor": "topk",
                                             "compress_ratio": 0.01,
                                             "topk_algorithm": "chunk",
                                             "memory": "residual",
                                             "communicator": "twoshot",
                                             "fusion": "flat"}},
-    # Fixed-cost psum majority vote (~4n bf16 on the wire, W-independent):
-    # the pod-scale route for sign methods, next to the packed allgather
-    # row below (also VERDICT round-2 item 5).
-    {"name": "signsgd_vote", "params": {"compressor": "signsgd",
-                                        "memory": "none",
-                                        "communicator": "sign_allreduce",
-                                        "fusion": "flat"}},
     {"name": "signsgd",    "params": {"compressor": "signsgd",
                                       "memory": "none",
                                       "communicator": "allgather",
@@ -132,40 +161,38 @@ CONFIGS = [
                                           "memory": "residual",
                                           "communicator": "allgather",
                                           "fusion": "flat"}},
-    {"name": "qsgd",       "params": {"compressor": "qsgd",
-                                      "quantum_num": 64,
-                                      "memory": "none",
-                                      "communicator": "allgather",
-                                      "fusion": "flat"}},
-    # VERDICT round-3 item 5: the Pallas fused-quantize kernel
-    # (ops/pallas_quant.py) has unit tests but no on-chip row; this pair
-    # (qsgd vs qsgd_pallas) is the evidence gate for flipping
-    # QSGDCompressor's use_pallas default to "auto".
-    # tpu_only: off-TPU this forces the quant kernel into interpret mode
-    # over the full 25.5M-param model — observed >45 min for ONE config on
-    # the CPU smoke (interpret Pallas is a per-element emulation); the
-    # kernel's off-TPU correctness is covered at small sizes by
-    # tests/test_pallas_quant.py, and the row only means anything on-chip.
-    {"name": "qsgd_pallas", "tpu_only": True,
-     "params": {"compressor": "qsgd",
-                "quantum_num": 64,
-                "use_pallas": True,
-                "memory": "none",
-                "communicator": "allgather",
-                "fusion": "flat"}},
-    {"name": "terngrad",   "params": {"compressor": "terngrad",
-                                      "memory": "none",
-                                      "communicator": "allgather",
-                                      "fusion": "flat"}},
-    {"name": "powersgd_r4", "params": {"compressor": "powersgd",
-                                       "compress_rank": 4,
-                                       "memory": "powersgd",
-                                       "communicator": "allreduce",
-                                       "fusion": "none"}},
-    {"name": "onebit",     "params": {"compressor": "onebit",
-                                      "memory": "residual",
-                                      "communicator": "allgather",
-                                      "fusion": "flat"}},
+    # Batch-size sweep tail (VERDICT round-3 item 4): bs64/bs128 show where
+    # the fixed compression cost amortizes; measured in round 4, kept for
+    # re-capture freshness. bench_configs re-measures the dense baseline at
+    # each row's own shapes so vs_baseline stays like-for-like.
+    *[{"name": f"topk1pct_bs{bs}", "per_device_bs": bs,
+       "params": {"compressor": "topk", "compress_ratio": 0.01,
+                  "topk_algorithm": "chunk", "memory": "residual",
+                  "communicator": "allgather", "fusion": "flat"}}
+      for bs in (64, 128)],
+    # bf16 master params at the amortizing batch. NOTE the fused Pallas
+    # Top-K kernel is f32-only (compressors/topk.py fused gate) so bf16
+    # grads take the STAGED chunk path — the note rides the emitted row.
+    {"name": "topk1pct_bs128_pbf16", "per_device_bs": 128,
+     "param_dtype": "bfloat16",
+     "note": "bf16 grads take the staged chunk Top-K "
+             "(the Pallas kernel is f32-only; staged is the default "
+             "everywhere since round 4 anyway)",
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "communicator": "allgather", "fusion": "flat"}},
+    # Ablation: chunk selection WITH the fused Pallas kernels forced on
+    # (ops/pallas_topk.py). The round-4 on-chip A/B measured the staged
+    # XLA path FASTER end-to-end (1602 vs 1441 imgs/sec at bs=32, same
+    # session), so 'auto' now resolves to staged and this row keeps the
+    # kernel measurable should a later change flip the verdict back.
+    {"name": "topk1pct_pallas", "params": {"compressor": "topk",
+                                           "compress_ratio": 0.01,
+                                           "topk_algorithm": "chunk",
+                                           "use_pallas": True,
+                                           "memory": "residual",
+                                           "communicator": "allgather",
+                                           "fusion": "flat"}},
     # Fusion ablation (headline pair unfused, and Horovod's default 64 MiB
     # bucketing — SURVEY.md §2.4):
     {"name": "none_unfused", "params": {"compressor": "none",
@@ -232,8 +259,13 @@ def _resume_configs():
         row = prev.get(cfg["name"])
         if not row:
             continue
-        want = (cfg.get("per_device_bs", 32), cfg.get("image_hw", 224),
-                cfg.get("param_dtype", "float32"))
+        # Shape defaults come from bench.py's exported constants — the
+        # literals here once duplicated bench_configs' and could drift
+        # (ADVICE r4): a collision with old rows could replay a
+        # wrong-shape row.
+        want = (cfg.get("per_device_bs", bench.TPU_DEFAULT_BS),
+                cfg.get("image_hw", bench.TPU_DEFAULT_HW),
+                cfg.get("param_dtype", bench.TPU_DEFAULT_PDTYPE))
         got = (row.get("per_device_bs"), row.get("image_hw"),
                row.get("param_dtype"))
         if want != got:
@@ -258,11 +290,24 @@ def _resume_configs():
 
 
 def _worker(platform: str) -> None:
-    configs = _resume_configs()
+    # The watcher's GRACE_BENCH_RESUME_SINCE env is TPU-only (ADVICE r4): a
+    # CPU-fallback worker inheriting it would re-emit cached platform-'tpu'
+    # rows and rewrite the TPU evidence file with a fresh captured_at over
+    # a rows list mixing CPU-measured rows. The operator's EXPLICIT
+    # GRACE_BENCH_RESUME override still works off-TPU (a CPU-fallback
+    # resume re-emits real on-chip rows instead of skip rows), but with
+    # evidence persistence disabled — re-emission must never masquerade as
+    # a fresh TPU capture.
+    if platform == "tpu":
+        configs, evidence_path = _resume_configs(), SWEEP_EVIDENCE_PATH
+    elif os.environ.get("GRACE_BENCH_RESUME"):
+        configs, evidence_path = _resume_configs(), None
+    else:
+        configs, evidence_path = [dict(c) for c in CONFIGS], None
     emit = bench.progressive_emit(
         lambda r: print(json.dumps(r), flush=True),
         n_expected=len(configs),
-        evidence_path=SWEEP_EVIDENCE_PATH,
+        evidence_path=evidence_path,
         metric="resnet50_all_configs_imgs_per_sec")
     bench.bench_configs(platform, configs, emit)
 

@@ -23,8 +23,12 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 class QSGDCompressor(Compressor):
     quantum_num: int = 64
     # Fused Pallas TPU kernel for the quantize step (in-core PRNG, one HBM
-    # pass — see grace_tpu/ops/pallas_quant.py). 'auto': on for TPU,
-    # interpreter-mode off elsewhere; True forces interpret mode off-TPU.
+    # pass — see grace_tpu/ops/pallas_quant.py). 'auto': resolves to the
+    # staged XLA path until the qsgd-vs-qsgd_pallas on-chip A/B lands
+    # (bench_all.py evidence gate) — matching Top-K, where the same A/B
+    # measured staged FASTER end-to-end and 'auto' means staged everywhere
+    # since round 4 (CHANGELOG/TRAINING.md). True forces the kernel
+    # (interpret mode off-TPU: slow, test-only).
     use_pallas: bool | str = False
 
     def __post_init__(self):
@@ -41,7 +45,10 @@ class QSGDCompressor(Compressor):
         if pallas_disabled(explicit=self.use_pallas is True, kernel="quant"):
             return False, False
         if self.use_pallas == "auto":
-            return jax.default_backend() == "tpu", False
+            # Staged until the on-chip qsgd_pallas evidence row validates
+            # the kernel end-to-end (ADVICE r4: 'auto' used to resolve
+            # kernel-on for TPU here while the docs said staged).
+            return False, False
         if self.use_pallas is True:
             on_tpu = jax.default_backend() == "tpu"
             return True, not on_tpu

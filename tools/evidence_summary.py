@@ -59,14 +59,22 @@ def _row_table(rows, title, value_key="imgs_per_sec",
     rows = [r for r in rows if r.get("config")]   # skip _meta-style rows
     for r in rows:
         cfg_name = r.get("config") or ""
+        # Scoped disables flag only the configs whose kernel family was
+        # forced onto the staged path — keyed off the row's stamped
+        # grace_params (ADVICE r4: a renamed config would silently lose
+        # the caveat under name-substring matching; old rows without the
+        # stamp keep the name fallback).
+        compressor = (r.get("grace_params") or {}).get("compressor", "")
         flags = ""
         if r.get("env_pallas_disabled"):
             flags = " ⚠staged"
-        elif r.get("env_pallas_quant_disabled") and "qsgd" in cfg_name:
-            # Scoped disables: flag only the configs whose kernel family
-            # was forced onto the staged path.
+        elif r.get("env_pallas_quant_disabled") and (
+                compressor == "qsgd" or
+                (not compressor and "qsgd" in cfg_name)):
             flags = " ⚠staged-quant"
-        elif r.get("env_pallas_topk_disabled") and "topk" in cfg_name:
+        elif r.get("env_pallas_topk_disabled") and (
+                compressor == "topk" or
+                (not compressor and "topk" in cfg_name)):
             flags = " ⚠staged-topk"
         if r.get("resumed"):
             flags += " ↻resumed"
