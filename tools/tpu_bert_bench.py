@@ -30,9 +30,17 @@ EVIDENCE_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "BENCH_BERT_TPU_LAST.json")
 
 CONFIGS = [
+    # fusion "none", twice over: (a) like-for-like with the powersgd config
+    # below (also per-leaf); (b) fusion "flat" on the 108.8M-element BERT
+    # gradient trips an XLA-TPU layout pathology — the materialized flat
+    # f32[108793346] consumed by the 200-way split gets laid out as
+    # f32[54396673,2]{1,0:T(8,128)}, whose minor-dim pad 2->128 inflates
+    # 435 MB to 27.8 GB and OOMs 16 GB HBM at compile. Allreduce chunks
+    # oversized dense psums to sidestep this (comm/__init__.py), but the
+    # per-leaf program is the cleaner baseline here regardless.
     {"name": "bert_dense", "params": {"compressor": "none", "memory": "none",
                                       "communicator": "allreduce",
-                                      "fusion": "flat"}},
+                                      "fusion": "none"}},
     {"name": "bert_powersgd_r4", "params": {"compressor": "powersgd",
                                             "compress_rank": 4,
                                             "memory": "powersgd",
