@@ -31,6 +31,31 @@ __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
            "SignAllreduce", "TwoShotAllreduce"]
 
 
+# XLA-TPU layout pathology guard (observed on BERT-base, 2026-08-01): a
+# materialized 1-D f32[108793346] that feeds an all-reduce and is then
+# consumed by a ~200-way slice/reshape fan-out gets assigned layout
+# f32[54396673,2]{1,0:T(8,128)} — the minor-dim pad 2->128 inflates 435 MB
+# to 27.8 GB and OOMs 16 GB HBM at compile time. Psumming such buffers in
+# fixed-size chunks keeps every materialized piece small enough that XLA
+# picks a sane layout (verified: same program compiles at 2.2 GB temp).
+# ResNet-50's 25.5 M-element fused gradient does NOT trigger it (measured
+# 4.7 MB temp), so chunking only engages above _PSUM_CHUNK_ELEMS to leave
+# proven-clean programs byte-identical.
+_PSUM_CHUNK_ELEMS = 8_388_608          # 32 MiB of f32 per collective chunk
+_PSUM_CHUNK_THRESHOLD = 33_554_432     # chunk only oversized 1-D payloads
+
+
+def _psum(t: jax.Array, axis_name: str) -> jax.Array:
+    """``lax.psum`` with oversized 1-D operands split into chunked psums
+    (numerically identical: psum is elementwise)."""
+    if t.ndim != 1 or t.shape[0] <= _PSUM_CHUNK_THRESHOLD:
+        return lax.psum(t, axis_name)
+    n = t.shape[0]
+    return jnp.concatenate([
+        lax.psum(t[o:min(o + _PSUM_CHUNK_ELEMS, n)], axis_name)
+        for o in range(0, n, _PSUM_CHUNK_ELEMS)])
+
+
 def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
                         axis_name: str, vote_dtype: str) -> jax.Array:
     """Decompress this rank's ±1 signs, psum, re-sign: exact majority vote
@@ -43,7 +68,7 @@ def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
                 f"vote_dtype='bfloat16' is integer-exact only up to world "
                 f"size 256; this axis has {w} — use vote_dtype='float32'.")
     dec = compressor.decompress(payload, ctx)
-    summed = lax.psum(dec.astype(vote_dtype), axis_name)
+    summed = _psum(dec.astype(vote_dtype), axis_name)
     out = (summed >= 0).astype(vote_dtype) * 2 - 1
     return out.astype(dec.dtype)
 
@@ -78,7 +103,7 @@ class Allreduce(Communicator):
                 "differently, e.g. per-rank indices or norms). Use "
                 "Allgather/Broadcast instead — reference compatibility "
                 "matrix, IMPLEMENTING.md:43-45.")
-        summed = tuple(lax.psum(t, self.axis_name) for t in payload)
+        summed = tuple(_psum(t, self.axis_name) for t in payload)
         if compressor.average and payload:
             if not all(jnp.issubdtype(t.dtype, jnp.inexact) for t in summed):
                 raise TypeError(

@@ -347,3 +347,21 @@ class TestTwoShotAllreduce:
         with pytest.raises(TypeError, match="stage2_feedback"):
             run_step(mesh, comm.TwoShotAllreduce(stage2_feedback=True),
                      C.TopKCompressor(0.25), DgcMemory(), jnp.asarray(x))
+
+
+def test_allreduce_chunked_psum_matches_whole(mesh, rng, monkeypatch):
+    """The oversized-1-D chunked psum (comm._psum, the XLA layout-pathology
+    guard) is numerically identical to one whole psum. Thresholds are
+    monkeypatched small so the test exercises the chunk seams (including a
+    ragged tail) without a 33M-element buffer."""
+    monkeypatch.setattr(comm, "_PSUM_CHUNK_THRESHOLD", 1000)
+    monkeypatch.setattr(comm, "_PSUM_CHUNK_ELEMS", 768)
+    x = rng.standard_normal((W, 2500)).astype(np.float32)  # 2500 % 768 != 0
+    out = run_exchange(mesh, comm.Allreduce(), C.NoneCompressor(average=False),
+                       jnp.asarray(x))
+    np.testing.assert_allclose(out, x.sum(0), rtol=1e-5)
+    # 2-D payloads and small 1-D payloads must bypass chunking entirely.
+    y = rng.standard_normal((W, 40, 12)).astype(np.float32)
+    out2 = run_exchange(mesh, comm.Allreduce(),
+                        C.NoneCompressor(average=False), jnp.asarray(y))
+    np.testing.assert_allclose(out2, y.sum(0), rtol=1e-5)
