@@ -88,8 +88,14 @@ CONFIGS = [
                 "communicator": "allgather", "fusion": "flat"}},
     # qsgd vs qsgd_pallas: THE evidence gate for flipping QSGD's
     # use_pallas default (VERDICT r3 item 5, two rounds dark).
+    # use_pallas pinned False: this row is the STAGED side of the
+    # qsgd-vs-qsgd_pallas A/B. (The round-5 A/B measured the kernel 42%
+    # faster, so 'auto' — the factory default — now resolves kernel-on
+    # for TPU; leaving this unpinned would make both rows measure the
+    # kernel and erase the ablation.)
     {"name": "qsgd",       "params": {"compressor": "qsgd",
                                       "quantum_num": 64,
+                                      "use_pallas": False,
                                       "memory": "none",
                                       "communicator": "allgather",
                                       "fusion": "flat"}},

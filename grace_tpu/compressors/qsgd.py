@@ -23,13 +23,14 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 class QSGDCompressor(Compressor):
     quantum_num: int = 64
     # Fused Pallas TPU kernel for the quantize step (in-core PRNG, one HBM
-    # pass — see grace_tpu/ops/pallas_quant.py). 'auto': resolves to the
-    # staged XLA path until the qsgd-vs-qsgd_pallas on-chip A/B lands
-    # (bench_all.py evidence gate) — matching Top-K, where the same A/B
-    # measured staged FASTER end-to-end and 'auto' means staged everywhere
-    # since round 4 (CHANGELOG/TRAINING.md). True forces the kernel
-    # (interpret mode off-TPU: slow, test-only).
-    use_pallas: bool | str = False
+    # pass — see grace_tpu/ops/pallas_quant.py). 'auto' (the default, also
+    # what grace_from_params passes): kernel on real TPU, staged XLA path
+    # elsewhere — the round-5 on-chip A/B measured the kernel 42% faster
+    # end-to-end (0.824 vs 0.580 of dense; BENCH_ALL_TPU_LAST.json
+    # 2026-08-01). Note the OPPOSITE resolution from Top-K, whose A/B
+    # measured staged faster. True forces the kernel even off-TPU
+    # (interpret mode: slow, test-only); False forces staged.
+    use_pallas: bool | str = "auto"
 
     def __post_init__(self):
         # Identity membership, not ==: 1 == True would pass equality
@@ -45,10 +46,13 @@ class QSGDCompressor(Compressor):
         if pallas_disabled(explicit=self.use_pallas is True, kernel="quant"):
             return False, False
         if self.use_pallas == "auto":
-            # Staged until the on-chip qsgd_pallas evidence row validates
-            # the kernel end-to-end (ADVICE r4: 'auto' used to resolve
-            # kernel-on for TPU here while the docs said staged).
-            return False, False
+            # Kernel on real TPU, staged elsewhere: the round-5 on-chip A/B
+            # (BENCH_ALL_TPU_LAST.json 2026-08-01, same session) measured
+            # the fused quant kernel at 2111 img/s vs 1483 staged (0.824 vs
+            # 0.580 of dense) — unlike Top-K, where the staged path wins,
+            # QSGD's per-element stochastic rounding gains 42% from the
+            # single-pass kernel with in-core PRNG.
+            return jax.default_backend() == "tpu", False
         if self.use_pallas is True:
             on_tpu = jax.default_backend() == "tpu"
             return True, not on_tpu

@@ -66,7 +66,7 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
             capacity_ratio=params.get("capacity_ratio", 0.25))
     if name == "qsgd":
         return C.QSGDCompressor(quantum_num=params.get("quantum_num", 64),
-                                use_pallas=params.get("use_pallas", False))
+                                use_pallas=params.get("use_pallas", "auto"))
     if name == "terngrad":
         return C.TernGradCompressor()
     if name == "signsgd":
