@@ -36,7 +36,8 @@ class Grace:
     compressor: Compressor
     memory: Memory
     communicator: Communicator
-    fusion: Any = None   # None | 'flat' | bucket bytes (see grace_transform)
+    fusion: Any = None   # None | 'flat' | 'grouped' | bucket bytes
+                         # (see grace_transform)
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,

@@ -161,26 +161,53 @@ def grace_transform(compressor: Compressor, memory: Memory,
       collectives ride ICI far better; selection-based compressors then pick
       k over the whole model (cross-tensor Top-K — slightly different but
       generally *stronger* selection than per-tensor).
+    * ``'grouped'`` — stack same-(shape, dtype) leaves and ``jax.vmap`` the
+      whole per-leaf pipeline over each stack: G same-shaped tensors cost
+      one *batched* compress (e.g. PowerSGD's G small QRs/matmuls become
+      batched MXU ops) and one batched collective instead of G small ones,
+      while per-tensor semantics are preserved EXACTLY (vmap is just
+      batching — unlike ``'flat'``, which changes selection semantics).
+      The natural choice for per-tensor algorithms on repeated-block
+      models (transformers: every encoder layer contributes identical
+      shapes). Per-leaf RNG derivation differs from ``None`` mode (keys
+      split per group, not folded per leaf index), so stochastic codecs
+      draw different — equally valid — randomness.
     * ``int`` — greedy whole-leaf buckets of at most this many bytes
       (Horovod's default fusion threshold is 64 MiB).
 
     Leaves are cast to their common result dtype inside a fused buffer and
     cast back on return.
     """
-    if isinstance(fusion, str) and fusion != "flat":
-        raise ValueError(f"fusion must be None, 'flat', or int bytes; "
-                         f"got {fusion!r}")
+    if isinstance(fusion, str) and fusion not in ("flat", "grouped"):
+        raise ValueError(f"fusion must be None, 'flat', 'grouped', or int "
+                         f"bytes; got {fusion!r}")
+    grouped = fusion == "grouped"
     bucket_bytes = None if fusion == "flat" else fusion
-    fused = fusion is not None
+    fused = fusion is not None and not grouped
 
     def _bucket_views(leaves):
         """Static bucketing plan for these leaves: (buckets, common dtype)."""
         return _bucketize([(jnp.shape(l), jnp.result_type(l))
                            for l in leaves], bucket_bytes)
 
+    def _group_views(leaves):
+        """Grouped-mode plan: leaf-index lists keyed by (shape, dtype), in
+        first-appearance order. Deterministic in leaf order so init and
+        update always agree on group numbering."""
+        groups: dict = {}
+        for i, leaf in enumerate(leaves):
+            key = (jnp.shape(leaf), str(jnp.result_type(leaf)))
+            groups.setdefault(key, []).append(i)
+        return list(groups.values())
+
     def init(params) -> GraceState:
         leaves = jax.tree_util.tree_leaves(params)
-        if fused:
+        if grouped:
+            stacks = [jnp.stack([leaves[i] for i in idxs])
+                      for idxs in _group_views(leaves)]
+            mem = tuple(jax.vmap(memory.init_state)(s) for s in stacks)
+            comp = tuple(jax.vmap(compressor.init_state)(s) for s in stacks)
+        elif fused:
             buckets, cdtype = _bucket_views(leaves)
             flats = [jnp.concatenate([jnp.ravel(leaves[i]).astype(cdtype)
                                       for i in idxs]) for idxs in buckets]
@@ -201,7 +228,32 @@ def grace_transform(compressor: Compressor, memory: Memory,
         base_key = jax.random.wrap_key_data(state.rng_key)
         step_key = jax.random.fold_in(base_key, state.count)
         new_mem, new_comp = [], []
-        if fused:
+        if grouped:
+            groups = _group_views(leaves)
+            if len(state.mem) != len(groups):
+                raise ValueError(
+                    f"grace state has {len(state.mem)} groups but the "
+                    f"leaves form {len(groups)} — the state was built under "
+                    "a different fusion setting. Re-init the optimizer "
+                    "state (or restore a checkpoint written with the same "
+                    "fusion config).")
+            outs = [None] * len(leaves)
+            for gi, idxs in enumerate(groups):
+                stacked = jnp.stack([leaves[i] for i in idxs])
+                keys = jax.random.split(
+                    jax.random.fold_in(step_key, gi), len(idxs))
+
+                def one(g, ms, cs, key):
+                    return communicator.step(g, ms, cs, memory, compressor,
+                                             key)
+
+                out, ms, cs = jax.vmap(one)(stacked, state.mem[gi],
+                                            state.comp[gi], keys)
+                for j, i in enumerate(idxs):
+                    outs[i] = out[j]
+                new_mem.append(ms)
+                new_comp.append(cs)
+        elif fused:
             buckets, cdtype = _bucket_views(leaves)
             if len(state.mem) != len(buckets):
                 raise ValueError(
