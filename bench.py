@@ -126,6 +126,8 @@ def step_flops(step, ts, batch) -> float | None:
         cost = fn.lower(ts, batch).cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
+        if cost is None:   # AOT/tunnel backends return no analysis; the
+            return None    # analytic FLOP model takes over silently
         f = float(cost.get("flops", 0.0))
         return f if f > 0 else None
     except Exception as e:
