@@ -65,7 +65,7 @@ def add_grace_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--compress-rank", type=int, default=4,
                    help="PowerSGD rank")
     g.add_argument("--fusion", default="flat",
-                   help="flat|none|<bytes> — gradient fusion buffer")
+                   help="flat|grouped|none|<bytes> — gradient fusion buffer")
     g.add_argument("--topk-algorithm", default="exact",
                    help="exact|approx|chunk — top-k selection strategy")
     g.add_argument("--recall-target", type=float, default=0.95,
@@ -86,7 +86,7 @@ def grace_params_from_args(args) -> dict:
     fusion = args.fusion
     if fusion in ("none", "None", ""):
         fusion = None
-    elif fusion != "flat":
+    elif fusion not in ("flat", "grouped"):
         fusion = int(fusion)
     params = {
         "compressor": args.compressor,
@@ -102,9 +102,9 @@ def grace_params_from_args(args) -> dict:
         "recall_target": args.recall_target,
     }
     # Only force use_pallas when the operator explicitly asked: the flag's
-    # resting default must leave each compressor's own default in charge
-    # (TopK defaults to 'auto'; QSGD stays off until its kernel has on-chip
-    # evidence — flipping it from a CLI default would bypass that gate).
+    # resting default must leave each compressor's own default in charge —
+    # 'auto' resolves per the measured on-chip A/Bs (TopK: staged; QSGD:
+    # kernel on TPU since the round-5 measurement, see TRAINING.md).
     if args.use_pallas != "auto":
         params["use_pallas"] = args.use_pallas == "on"
     if getattr(args, "memory_dtype", None):
