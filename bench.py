@@ -45,34 +45,42 @@ TPU_DEFAULT_HW = 224
 TPU_DEFAULT_PDTYPE = "float32"
 
 HEADLINE = [
-    # Both sides get the fusion buffer — Horovod fuses the uncompressed
-    # baseline too, so a like-for-like ratio must as well.
+    # Per-leaf (fusion "none") on BOTH sides — the reference's own dist
+    # backend issues one collective per tensor (SURVEY.md §3.3), so the
+    # per-tensor pair is protocol-faithful AND measured fastest: the
+    # round-5 on-chip A/B at bs=256 (2026-08-01, same session) put
+    # per-leaf Top-K at 2263.9 img/s = 0.9885x dense (spread 0.25%) vs
+    # 0.9346x for the fused-flat pair — the whole-model fusion buffer
+    # (concat + one monolithic pipeline), not the selection, carries most
+    # of the fused overhead. The fused rows stay in bench_all (fusion is
+    # the right call on real multi-host meshes where 161 small collectives
+    # pay per-launch latency; single-chip the step has no such cost).
     #
     # per_device_bs=256: chosen from the measured on-chip bs sweep
-    # (BENCH_ALL_TPU_LAST.json, 2026-07-31): the ~10 ms fixed compression
-    # cost is ~45% of a bs=32 step (0.56x dense) but amortizes to >=0.92x
-    # at bs=256 — the batch a throughput-tuned ResNet-50 run would use
-    # anyway. The dense baseline is measured at the SAME bs in the same
-    # session, so the ratio stays like-for-like; bs=32..256 rows stay in
-    # the bench_all sweep for the full curve. (BASELINE.md north star pins
-    # no batch size; the reference's synthetic harness default is bs=32,
-    # kept as the sweep's first point.)
+    # (BENCH_ALL_TPU_LAST.json): the fixed compression cost is ~45% of a
+    # bs=32 step but amortizes at bs=256 — the batch a throughput-tuned
+    # ResNet-50 run would use anyway. The dense baseline is measured at
+    # the SAME bs in the same session, so the ratio stays like-for-like;
+    # bs=32..256 rows stay in the bench_all sweep for the full curve.
+    # (BASELINE.md north star pins no batch size; the reference's
+    # synthetic harness default is bs=32, kept as the sweep's first point.)
     {"name": "none", "per_device_bs": 256,
      "params": {"compressor": "none", "memory": "none",
                 "communicator": "allreduce",
-                "fusion": "flat"}},
+                "fusion": "none"}},
     # Top-K selection uses the chunked argmax (top-1 per strided chunk, a
     # pure VPU reduction) with the scatter-free one-hot decompress
     # (ops/sparse.py chunkwise_dense). Measured on the chip in one
     # interleaved session (BENCH_ALL_TPU_LAST.json, 2026-07-31): chunk
-    # 0.56x dense at bs=32 rising to 0.92x at bs=256, vs approx_max_k
-    # 0.69x (bs=32) and exact-sort far below — both the full-buffer top-k
-    # select AND the scatter in decompress were the bottleneck; chunk mode
-    # removes both. Selection is DGC-style relaxed (top-1 per chunk, not global
-    # top-k); residual error feedback compensates — chunk tracks exact
-    # step-for-step on a toy convex problem (2.303->0.534 vs 0.533 at 1%
-    # over 120 steps, 8-device mesh) and the real-MNIST curve is committed
-    # at examples/logs/mnist10k_topk1pct_chunk.tsv. bench_all.py measures
+    # 0.56x dense at bs=32 rising to 0.92x at bs=256 (fused), vs
+    # approx_max_k 0.69x (bs=32) and exact-sort far below — both the
+    # full-buffer top-k select AND the scatter in decompress were the
+    # bottleneck; chunk mode removes both. Selection is DGC-style relaxed
+    # (top-1 per chunk, not global top-k); residual error feedback
+    # compensates — chunk tracks exact step-for-step on a toy convex
+    # problem (2.303->0.534 vs 0.533 at 1% over 120 steps, 8-device mesh)
+    # and the real-MNIST curve is committed at
+    # examples/logs/mnist10k_topk1pct_chunk.tsv. bench_all.py measures
     # exact/approx/chunk side by side.
     {"name": "topk1pct", "per_device_bs": 256,
      "params": {"compressor": "topk",
@@ -80,7 +88,7 @@ HEADLINE = [
                 "topk_algorithm": "chunk",
                 "memory": "residual",
                 "communicator": "allgather",
-                "fusion": "flat"}},
+                "fusion": "none"}},
 ]
 
 

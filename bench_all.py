@@ -93,6 +93,13 @@ CONFIGS = [
     # faster, so 'auto' — the factory default — now resolves kernel-on
     # for TPU; leaving this unpinned would make both rows measure the
     # kernel and erase the ablation.)
+    # Fused dense at the headline batch: with the round-5 headline moving
+    # to per-leaf (see bench.HEADLINE), this row keeps the strict
+    # fused-vs-fused pairing measurable against topk1pct_bs256 above
+    # (dense fused-vs-unfused measured 2285.9 vs 2289.8 — ~0.2%).
+    {"name": "none_flat_bs256", "per_device_bs": 256,
+     "params": {"compressor": "none", "memory": "none",
+                "communicator": "allreduce", "fusion": "flat"}},
     {"name": "qsgd",       "params": {"compressor": "qsgd",
                                       "quantum_num": 64,
                                       "use_pallas": False,
