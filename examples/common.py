@@ -124,7 +124,11 @@ def grace_provenance(args) -> dict:
     one place, so a new curve-affecting knob (round-4 case:
     --memory-dtype) cannot be added without its provenance stamp."""
     prov = {"compressor": args.compressor, "memory": args.memory,
-            "communicator": args.communicator}
+            "communicator": args.communicator,
+            # fusion changes selection semantics (flat = global-k,
+            # none = per-tensor-k, the round-5 headline mode) — a curve
+            # without it is ambiguous evidence.
+            "fusion": args.fusion}
     if getattr(args, "memory_dtype", None):
         prov["memory_dtype"] = args.memory_dtype
     if args.compressor == "topk":
