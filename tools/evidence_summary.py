@@ -122,10 +122,12 @@ def _curve_table():
         rec = dict(zip(header, last))
         acc = rec.get("test_acc") or rec.get("top1Accuracy")
         data = prov.get("data", "?").split(" (")[0]   # drop inline caveats
+        comm_s = prov.get("communicator", "?")
+        if prov.get("fusion"):   # stamped since round 5; absent = pre-stamp
+            comm_s += f" ({prov['fusion']})"
         rows.append((os.path.basename(path), data,
                      prov.get("compressor", "?"), prov.get("memory", "?"),
-                     prov.get("memory_dtype", ""),
-                     prov.get("communicator", "?"),
+                     prov.get("memory_dtype", ""), comm_s,
                      rec.get("epoch", "?"), acc if acc is not None else "?"))
     if not rows:
         return []
