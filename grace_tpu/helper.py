@@ -142,9 +142,10 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
 def grace_from_params(params: Dict[str, Any]) -> Grace:
     """Configure the triad from the reference's params-dict schema.
 
-    ``fusion`` (None | 'flat' | int bytes) is a grace-tpu extension with no
-    reference analog in the params dict — Horovod's fusion buffer was a
-    buried env knob (HOROVOD_FUSION_THRESHOLD); here it is first-class.
+    ``fusion`` (None | 'flat' | 'grouped' | int bytes) is a grace-tpu
+    extension with no reference analog in the params dict — Horovod's fusion
+    buffer was a buried env knob (HOROVOD_FUSION_THRESHOLD); here it is
+    first-class.
     """
     axis = params.get("axis_name", DEFAULT_AXIS)
     fusion = params.get("fusion")
