@@ -173,6 +173,22 @@ def build() -> str:
         parts += _row_table(
             sweep["rows"], f"TPU per-algorithm sweep (captured {cap})"
             + partial)
+        # Same-named rows measured under different stamped params (e.g. the
+        # round-5 headline moving to per-leaf after the sweep captured the
+        # fused pair) read as contradictions without a caveat.
+        if head and head.get("rows"):
+            hp = {r["config"]: r.get("grace_params") for r in head["rows"]
+                  if r.get("grace_params")}
+            drift = [r["config"] for r in sweep["rows"]
+                     if r.get("grace_params") and
+                     hp.get(r.get("config")) not in (None,
+                                                     r["grace_params"])]
+            if drift:
+                parts += ["", "Note: " + ", ".join(sorted(set(drift))) +
+                          " above were captured under different params than "
+                          "the same-named headline rows (each row stamps its "
+                          "own `grace_params`; the headline is the "
+                          "authoritative config)."]
         parts.append("")
     variants = _load("TPU_VARIANTS.jsonl")
     if variants:
