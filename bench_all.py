@@ -127,6 +127,13 @@ CONFIGS = [
     # the pod-scale route for sign methods, next to the packed allgather
     # row below (also VERDICT round-2 item 5). Errored mid-remote-compile
     # in round 4 when the tunnel dropped — verify the retry lands.
+    # The vote at the amortizing batch, per-leaf: 0.9775x dense single-chip
+    # (round-5 capture) with recv flat in W (bf16 psum = half dense's
+    # bytes), so it projects above dense on DCN at every W — the third
+    # winning family after PowerSGD and small-mesh per-leaf Top-K.
+    {"name": "signsgd_vote_bs256", "per_device_bs": 256,
+     "params": {"compressor": "signsgd", "memory": "residual",
+                "communicator": "sign_allreduce", "fusion": "none"}},
     {"name": "signsgd_vote", "params": {"compressor": "signsgd",
                                         "memory": "none",
                                         "communicator": "sign_allreduce",
