@@ -170,8 +170,9 @@ def setup_platform(platform: str):
         # Same dance as tests/conftest.py: the image's sitecustomize latches
         # jax onto the TPU tunnel, so env vars alone are not enough.
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
-        from grace_tpu.parallel import relax_cpu_collective_timeouts
+        from grace_tpu.parallel import (relax_cpu_collective_timeouts,
+                                        set_cpu_device_count)
+        set_cpu_device_count(8)
         relax_cpu_collective_timeouts()  # 8 device threads, few-core host
     devices = jax.devices()
     if platform == "tpu" and devices[0].platform != "tpu":

@@ -38,7 +38,8 @@ if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
     _m = _re.search(r"--xla_force_host_platform_device_count=(\d+)",
                     os.environ.get("XLA_FLAGS", ""))
     if _m:
-        _jax.config.update("jax_num_cpu_devices", int(_m.group(1)))
+        from grace_tpu.parallel import set_cpu_device_count
+        set_cpu_device_count(int(_m.group(1)))
 
 import numpy as np
 

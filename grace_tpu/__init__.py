@@ -13,6 +13,8 @@ from grace_tpu.core import Communicator, Compressor, Memory
 from grace_tpu.comm import (Allgather, Allreduce, Broadcast, Identity,
                             SignAllreduce, TwoShotAllreduce)
 from grace_tpu.helper import Grace, grace_from_params
+from grace_tpu.resilience import (ChaosCommunicator, ChaosCompressor,
+                                  GuardState, guard_transform, guarded_chain)
 from grace_tpu.transform import GraceState, grace_transform
 from grace_tpu.train import (TrainState, init_train_state, make_eval_step,
                              make_train_step)
@@ -25,6 +27,8 @@ __all__ = [
     "Allreduce", "Allgather", "Broadcast", "Identity", "SignAllreduce",
     "TwoShotAllreduce",
     "Grace", "grace_from_params", "grace_transform", "GraceState",
+    "GuardState", "guard_transform", "guarded_chain",
+    "ChaosCompressor", "ChaosCommunicator",
     "TrainState", "init_train_state", "make_train_step", "make_eval_step",
     "data_parallel_mesh", "make_mesh",
     "__version__",

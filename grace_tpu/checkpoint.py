@@ -17,14 +17,42 @@ hand, unlike the reference's ``if hvd.rank()==0`` idiom.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 __all__ = ["Checkpointer", "save_checkpoint", "restore_checkpoint",
-           "latest_step"]
+           "latest_step", "divergence_rollback"]
+
+
+def _path_names(entry) -> str:
+    """Normalize one pytree key-path entry to its bare name, so dict-based
+    checkpoint metadata compares against NamedTuple-based targets."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _structure_paths(tree) -> set:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {"/".join(_path_names(e) for e in path) for path, _ in flat}
+
+
+def _first_structure_mismatch(stored, target) -> Optional[Tuple[str, str]]:
+    """(path, which-side) of the first leaf present in only one structure."""
+    s_paths = _structure_paths(stored)
+    t_paths = _structure_paths(target)
+    only_target = sorted(t_paths - s_paths)
+    only_stored = sorted(s_paths - t_paths)
+    if only_target:
+        return only_target[0], "target"
+    if only_stored:
+        return only_stored[0], "checkpoint"
+    return None
 
 
 class Checkpointer:
@@ -33,10 +61,21 @@ class Checkpointer:
     Usage::
 
         ckpt = Checkpointer(dir, max_to_keep=3)
-        ckpt.save(step, state)                  # async; returns immediately
+        ckpt.save(step, state, good=True)       # async; returns immediately
         state = ckpt.restore(abstract_state)    # latest, or step=N
+        state = ckpt.restore_last_good(abstract_state)   # divergence recovery
         ckpt.close()                            # wait for pending writes
+
+    ``good`` records per-step health metadata (a sidecar JSON next to the
+    orbax steps, written by process 0): a step saved with ``good=True`` is a
+    candidate for :meth:`restore_last_good`, the entry point of the
+    divergence-rollback path (see :func:`divergence_rollback`). The caller
+    decides what "good" means — typically "the guard reported no skipped
+    steps and a finite loss since the previous save" (see
+    ``grace_tpu.utils.metrics.guard_report``).
     """
+
+    _GOOD_FILE = "last_known_good.json"
 
     def __init__(self, directory: str | os.PathLike,
                  max_to_keep: Optional[int] = 3,
@@ -44,34 +83,124 @@ class Checkpointer:
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps)
-        self._mgr = ocp.CheckpointManager(os.path.abspath(str(directory)),
-                                          options=options)
+        # Registering the handler up front (rather than letting the first
+        # save() do it lazily) is what makes item_metadata() work on a
+        # freshly opened manager — the restore-side structure check needs it.
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(str(directory)), options=options,
+            item_handlers=ocp.StandardCheckpointHandler())
 
     @property
     def directory(self) -> str:
         return str(self._mgr.directory)
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
-        """Save ``state`` (any pytree of arrays/scalars) at ``step``."""
-        return self._mgr.save(step, args=ocp.args.StandardSave(state),
-                              force=force)
+    # -- last-known-good tracking -------------------------------------------
+    @property
+    def _good_path(self) -> str:
+        return os.path.join(self.directory, self._GOOD_FILE)
 
+    def _read_good(self) -> list:
+        try:
+            with open(self._good_path) as f:
+                return list(json.load(f)["good_steps"])
+        except (FileNotFoundError, json.JSONDecodeError, KeyError):
+            return []
+
+    def _write_good(self, steps: list) -> None:
+        if jax.process_index() != 0:
+            return
+        tmp = self._good_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"good_steps": sorted(set(int(s) for s in steps))}, f)
+        os.replace(tmp, self._good_path)
+
+    def save(self, step: int, state: Any, force: bool = False,
+             good: Optional[bool] = None) -> bool:
+        """Save ``state`` (any pytree of arrays/scalars) at ``step``.
+
+        ``good`` marks (True) or unmarks (False) this step as known-good in
+        the per-step metadata; ``None`` leaves the record untouched.
+        """
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
+        if good is not None and saved:
+            self.mark_good(step, good)
+        return saved
+
+    def mark_good(self, step: int, good: bool = True) -> None:
+        """(Un)mark an already-saved step as known-good — e.g. after a
+        validation pass finished long after the save was issued."""
+        steps = [s for s in self._read_good() if s != step]
+        if good:
+            steps.append(step)
+        self._write_good(steps)
+
+    def last_good_step(self) -> Optional[int]:
+        """Newest step recorded good that still exists on disk (retention
+        may have garbage-collected older good steps)."""
+        existing = set(self._mgr.all_steps())
+        good = [s for s in self._read_good() if s in existing]
+        return max(good) if good else None
+
+    def restore_last_good(self, target: Any) -> Any:
+        """Restore the newest known-good step (see :meth:`save` ``good=``)."""
+        step = self.last_good_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no known-good checkpoint under {self.directory} — save "
+                "with good=True to record rollback candidates")
+        return self.restore(target, step=step)
+
+    # -- restore ------------------------------------------------------------
     def restore(self, target: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure of ``target``.
 
         ``target`` may be a concrete state (its arrays give shape/dtype/
         sharding) or an abstract one built with ``jax.eval_shape``. Restores
         the latest step when ``step`` is None.
+
+        A checkpoint whose tree structure does not match ``target`` (e.g.
+        resume after an optimizer/model config change) raises a ``ValueError``
+        naming the first mismatching leaf path, instead of orbax's raw
+        internal traceback.
         """
         if step is None:
             step = self._mgr.latest_step()
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoint found under {self.directory}")
+        self._check_structure(step, target)
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           target)
-        return self._mgr.restore(step,
-                                 args=ocp.args.StandardRestore(abstract))
+        try:
+            return self._mgr.restore(step,
+                                     args=ocp.args.StandardRestore(abstract))
+        except (ValueError, KeyError, TypeError) as e:
+            # Structure pre-check is name-based and conservative; anything
+            # it missed (or metadata it could not read) lands here.
+            raise ValueError(
+                f"checkpoint step {step} under {self.directory} does not "
+                f"restore into the given target structure — did the "
+                f"optimizer or model config change since it was written? "
+                f"(orbax: {e})") from e
+
+    def _check_structure(self, step: int, target: Any) -> None:
+        try:
+            stored = self._mgr.item_metadata(step)
+        except Exception:
+            return   # metadata unavailable: let restore itself decide
+        if stored is None:
+            return
+        mismatch = _first_structure_mismatch(stored, target)
+        if mismatch is not None:
+            path, side = mismatch
+            other = "checkpoint" if side == "target" else "target"
+            raise ValueError(
+                f"checkpoint structure mismatch at leaf '{path}': present "
+                f"in the {side} but not in the {other} (checkpoint step "
+                f"{step} under {self.directory}). Restore with a target "
+                "built from the same optimizer/model config the checkpoint "
+                "was written with.")
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -116,3 +245,27 @@ def latest_step(directory: str | os.PathLike) -> Optional[int]:
         return None
     with Checkpointer(directory) as ckpt:
         return ckpt.latest_step()
+
+
+def divergence_rollback(ckpt: Checkpointer, target: Any, *,
+                        failed_step: int, skip_window: int = 1
+                        ) -> Tuple[Any, int, int]:
+    """Train-loop recovery from sustained divergence: restore + data skip.
+
+    When the in-graph guard reports sustained non-finite steps (e.g.
+    ``guard_report(state)['consecutive']`` beyond the loop's patience) the
+    loop calls this instead of continuing::
+
+        state, good_step, resume_at = divergence_rollback(
+            ckpt, state, failed_step=i, skip_window=8)
+        data_cursor = resume_at   # jump PAST the offending batches
+
+    Returns ``(state, good_step, resume_at)``: the last-known-good state
+    (see ``Checkpointer.save(..., good=True)``), the step it came from, and
+    ``failed_step + skip_window`` — the data cursor that skips the window
+    that poisoned the run, so the retry does not replay the same bad batch
+    sequence straight into a second divergence.
+    """
+    state = ckpt.restore_last_good(target)
+    good_step = ckpt.last_good_step()
+    return state, good_step, failed_step + skip_window

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from grace_tpu.core import Communicator, Compressor, Ctx, Payload
+from grace_tpu.core import (Communicator, Compressor, Ctx, Payload,
+                            axis_size)
 
 __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
            "SignAllreduce", "TwoShotAllreduce"]
@@ -62,7 +63,7 @@ def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
     at fixed (world-size-independent) collective cost — SURVEY.md §7 hard
     part 4. Shared by SignAllreduce and the Allreduce vote routing."""
     if vote_dtype == "bfloat16":
-        w = lax.axis_size(axis_name)       # static at trace time
+        w = axis_size(axis_name)       # static at trace time
         if w > 256:
             raise ValueError(
                 f"vote_dtype='bfloat16' is integer-exact only up to world "
@@ -139,7 +140,7 @@ class Allgather(Communicator):
             for t in payload)
         fused = getattr(compressor, "fused_aggregate_decompress", None)
         if fused is not None:
-            out = fused(gathered, ctx, lax.axis_size(self.axis_name))
+            out = fused(gathered, ctx, axis_size(self.axis_name))
             if out is not None:      # handles aggregate + average itself
                 return out
         stacked = jax.vmap(lambda p: compressor.decompress(p, ctx))(gathered)
@@ -332,7 +333,7 @@ class TwoShotAllreduce(Communicator):
                 f"{type(compressor).__name__} carries cross-step state "
                 "(init_state != None) that has no per-chunk meaning — use "
                 "Allgather/Allreduce instead.")
-        w = lax.axis_size(self.axis_name)               # static at trace time
+        w = axis_size(self.axis_name)               # static at trace time
         shape, dtype = x.shape, x.dtype
         compensated, mem_state = memory.compensate(x, mem_state)
         flat = compensated.reshape(-1)

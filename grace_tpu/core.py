@@ -47,6 +47,18 @@ State = Any
 DEFAULT_AXIS = "data"
 
 
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis, across JAX versions.
+
+    ``lax.axis_size`` only exists on newer JAX; on older releases (e.g.
+    0.4.37) ``lax.psum(1, axis)`` of a Python int constant-folds to a static
+    int at trace time, which is exactly the same value.
+    """
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 @dataclasses.dataclass(frozen=True)
 class Compressor:
     """Lossy gradient codec (reference ABC: grace_dl/dist/__init__.py:15-35).
@@ -176,7 +188,7 @@ class Communicator:
         fused = getattr(compressor, "fused_feedback_compress", None)
         if coeffs is not None and fused is not None and mem_state is not None:
             fused_out = fused(x, mem_state, coeffs, rng,
-                              world=lambda: lax.axis_size(self.axis_name))
+                              world=lambda: axis_size(self.axis_name))
             if fused_out is not None:
                 payload, ctx, mem_state = fused_out
                 out = self.exchange(payload, ctx, compressor)

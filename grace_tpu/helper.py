@@ -38,11 +38,13 @@ class Grace:
     communicator: Communicator
     fusion: Any = None   # None | 'flat' | 'grouped' | bucket bytes
                          # (see grace_transform)
+    escape: Any = None   # None | dense Compressor: the resilience escape
+                         # hatch (see grace_transform / resilience.guard)
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,
                                self.communicator, seed=seed,
-                               fusion=self.fusion)
+                               fusion=self.fusion, escape=self.escape)
 
 
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
@@ -151,7 +153,18 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
     fusion = params.get("fusion")
     if fusion in ("none", "None", ""):   # CLI-style spelling of "no fusion"
         fusion = None
+    escape = params.get("escape")
+    if isinstance(escape, str):
+        if escape in ("none", "dense"):
+            escape = C.NoneCompressor()
+        elif escape in ("fp16", "bf16", "bfloat16"):
+            escape = C.FP16Compressor(
+                dtype="float16" if escape == "fp16" else "bfloat16")
+        else:
+            raise ValueError(f"unknown escape compressor {escape!r} — use "
+                             "'none'/'dense', 'fp16', or 'bf16'")
     return Grace(compressor=_build_compressor(params, axis),
                  memory=_build_memory(params, axis),
                  communicator=_build_communicator(params, axis),
-                 fusion=fusion)
+                 fusion=fusion,
+                 escape=escape)

@@ -36,7 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from grace_tpu.helper import Grace
-from grace_tpu.parallel import data_parallel_mesh
+from grace_tpu.parallel import data_parallel_mesh, shard_map
 from grace_tpu.transform import (add_world_axis, partition_specs,
                                  strip_world_axis)
 
@@ -97,7 +97,7 @@ class GraceBridge:
         # Global-layout state: grace mem/comp leaves sharded over the axis.
         abstract = jax.eval_shape(tx.init, [template])
         specs = partition_specs(abstract, self.axis)
-        init_fn = jax.shard_map(
+        init_fn = shard_map(
             lambda t: add_world_axis(tx.init([t[0]])),
             mesh=self.mesh, in_specs=(P(self.axis),), out_specs=specs,
             check_vma=False)
@@ -109,7 +109,7 @@ class GraceBridge:
             out, new_state = tx.update([local[0]], strip_world_axis(state))
             return add_world_axis(new_state), out[0]
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             device_step, mesh=self.mesh,
             in_specs=(specs, P(self.axis)),
             out_specs=(specs, P()),
@@ -123,7 +123,7 @@ class GraceBridge:
             out, new_state = tx.update([row], strip_world_axis(state))
             return add_world_axis(new_state), out[0]
 
-        sharded_row = jax.shard_map(
+        sharded_row = shard_map(
             device_step_row, mesh=self.mesh,
             in_specs=(specs, P()),
             out_specs=(specs, P()),

@@ -24,6 +24,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+
+def _interpret_mode(interpret: bool):
+    """pallas_call interpret= across JAX versions: newer Pallas wants a
+    pltpu.InterpretParams() instance, older (e.g. 0.4.37) a plain bool."""
+    if not interpret:
+        return False
+    if hasattr(pltpu, "InterpretParams"):
+        return pltpu.InterpretParams()
+    return True
+
+
 LANES = 256          # last-dim tile (2 × 128 lanes)
 ROWS_PER_BLOCK = 64  # sublane tile multiple
 
@@ -101,6 +112,6 @@ def quantize_stochastic(flat: jax.Array, norm: jax.Array, seed: jax.Array,
         out_specs=pl.BlockSpec((ROWS_PER_BLOCK, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, LANES), out_dtype),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=_interpret_mode(interpret),
     )(seed.reshape(1).astype(jnp.int32), scale.reshape(1), x2d)
     return out.reshape(-1)[:n]

@@ -44,6 +44,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+
+def _interpret_mode(interpret: bool):
+    """pallas_call interpret= across JAX versions: newer Pallas wants a
+    pltpu.InterpretParams() instance, older (e.g. 0.4.37) a plain bool."""
+    if not interpret:
+        return False
+    if hasattr(pltpu, "InterpretParams"):
+        return pltpu.InterpretParams()
+    return True
+
 # Per-block VMEM budget across ALL of a kernel's f32 block buffers (Mosaic
 # pads each buffer's sublane count to 8 and double-buffers; the 4 MiB
 # budget leaves that headroom within ~16 MiB VMEM); lane blocks must be
@@ -167,7 +177,7 @@ def chunk_compress_feedback(flat: jax.Array, residual, k: int,
             jax.ShapeDtypeStruct((main_rows, k), jnp.float32),
             jax.ShapeDtypeStruct((1, k), jnp.float32),
         ],
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=_interpret_mode(interpret),
     )(*operands)
     new_resid = resid_main.reshape(-1)
     if rem:
@@ -260,7 +270,7 @@ def chunk_aggregate_dense(vals: jax.Array, win: jax.Array, k: int, n: int,
                                 memory_space=pltpu.VMEM)],
         out_shape=[jax.ShapeDtypeStruct((main_rows, k), jnp.float32),
                    jax.ShapeDtypeStruct((1, k), jnp.float32)],
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=_interpret_mode(interpret),
     )(vals, win)
     out = out_main.reshape(-1)
     if rem:

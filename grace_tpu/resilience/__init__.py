@@ -1,0 +1,54 @@
+"""In-graph training resilience: step guard, graceful degradation, chaos.
+
+Three pieces, designed to compose with the existing triad without touching
+it (SURVEY.md has no counterpart — the reference assumes a fault-free run):
+
+* :func:`guard_transform` — optax wrapper around the *whole* chain that
+  detects non-finite / exploding post-exchange updates in-graph and skips
+  the step atomically (params, optimizer state, and every GraceState
+  mem/comp leaf roll back together). See ``resilience/guard.py`` for why
+  ``optax.apply_if_finite`` cannot do this for error-feedback state.
+* the dense escape hatch — ``grace_transform(escape=...)`` +
+  ``fallback_after``/``fallback_steps`` on the guard: after K consecutive
+  bad steps the exchange degrades to a dense (none/fp16 + psum) all-reduce
+  for M cooldown steps, then compression re-arms.
+* :mod:`~grace_tpu.resilience.chaos` — deterministic fault injectors
+  (NaN/Inf implants, payload bit-flips, single-rank faults, stale
+  residuals) as Compressor/Communicator wrappers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+from grace_tpu.resilience.chaos import ChaosCommunicator, ChaosCompressor
+from grace_tpu.resilience.guard import GuardState, guard_transform
+
+__all__ = ["GuardState", "guard_transform", "guarded_chain",
+           "ChaosCompressor", "ChaosCommunicator"]
+
+
+def guarded_chain(grace, *txs: optax.GradientTransformation,
+                  seed: int = 0,
+                  max_norm: Optional[float] = None,
+                  check_state: bool = True,
+                  fallback_after: Optional[int] = None,
+                  fallback_steps: Optional[int] = None
+                  ) -> optax.GradientTransformation:
+    """``guard_transform(optax.chain(grace.transform(seed), *txs))`` with the
+    guard's cross-rank flag reduction wired to the grace mesh axis.
+
+    ``grace`` is a :class:`~grace_tpu.helper.Grace` bundle; configure its
+    ``escape`` field (e.g. ``escape='fp16'`` in ``grace_from_params``) to
+    arm the dense fallback window that ``fallback_after``/``fallback_steps``
+    control.
+    """
+    inner = optax.chain(grace.transform(seed=seed), *txs)
+    return guard_transform(inner,
+                           max_norm=max_norm,
+                           check_state=check_state,
+                           fallback_after=fallback_after,
+                           fallback_steps=fallback_steps,
+                           axis_name=grace.communicator.axis_name)

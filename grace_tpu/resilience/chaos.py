@@ -1,0 +1,200 @@
+"""Chaos harness: deterministic, seedable fault injectors.
+
+Faults are expressed as :class:`~grace_tpu.core.Compressor` /
+:class:`~grace_tpu.core.Communicator` wrappers, so they slot into any
+existing pipeline (``grace_from_params`` triads, ``guard_transform`` chains,
+bare ``Communicator.step`` calls) without touching the code under test.
+Everything is a pure function of the rng key the transform already threads
+through the pipeline: the same run with the same seeds produces the same
+faults, bit-for-bit, which is what makes guard regressions reproducible.
+
+Fault classes (ScaleCom-style stability probes, PAPERS.md):
+
+* **NaN/Inf implants** — overwrite one random element of the gradient with
+  NaN/Inf at a per-(step, leaf) probability, optionally on exactly one mesh
+  rank (``rank=``, gated in-graph via ``lax.axis_index`` so it is legal
+  inside ``shard_map``).
+* **Payload bit-flips** — flip one random bit of one random element of each
+  wire payload tensor (bitcast → xor → bitcast), modelling interconnect /
+  DMA corruption that checksums missed.
+* **Stale residuals** — suppress this step's error-feedback state update so
+  the memory replays last step's residual, modelling a lost/duplicated
+  update in a sharded state store.
+
+The wrappers deliberately do NOT forward the fused-kernel hooks
+(``fused_feedback_compress`` / ``fused_aggregate_decompress``): the fused
+paths would bypass the injection points, silently turning the chaos run
+into a clean one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import (Communicator, Compressor, Ctx, Memory, Payload,
+                            State)
+
+__all__ = ["ChaosCompressor", "ChaosCommunicator"]
+
+_UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def _gate(rank: Optional[int], axis_name: str) -> jax.Array:
+    """True on the faulted rank (all ranks when ``rank`` is None)."""
+    if rank is None:
+        return jnp.ones((), jnp.bool_)
+    return lax.axis_index(axis_name) == rank
+
+
+def _implant(x: jax.Array, key: jax.Array, value) -> jax.Array:
+    """``x`` with one random element overwritten by ``value``."""
+    if x.size == 0:
+        return x
+    pos = jax.random.randint(key, (), 0, x.size)
+    flat = x.reshape(-1)
+    return flat.at[pos].set(jnp.asarray(value, x.dtype)).reshape(x.shape)
+
+
+def _flip_one_bit(t: jax.Array, key: jax.Array) -> jax.Array:
+    """``t`` with one random bit of one random element flipped."""
+    if t.size == 0 or t.dtype == jnp.bool_:
+        return t
+    uint = _UINT[t.dtype.itemsize]
+    kpos, kbit = jax.random.split(key)
+    pos = jax.random.randint(kpos, (), 0, t.size)
+    bit = jax.random.randint(kbit, (), 0, t.dtype.itemsize * 8)
+    flat = lax.bitcast_convert_type(t, uint).reshape(-1)
+    flipped = flat.at[pos].set(
+        flat[pos] ^ (jnp.asarray(1, uint) << bit.astype(uint)))
+    return lax.bitcast_convert_type(flipped.reshape(t.shape), t.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosCompressor(Compressor):
+    """Fault-injecting wrapper around any compressor.
+
+    ``nan_prob``/``inf_prob`` implant into the *input* tensor before the
+    inner codec sees it (a poisoned gradient); ``bitflip_prob`` corrupts
+    each *payload* tensor after encoding (wire corruption). Probabilities
+    are per (step, leaf) — the rng handed to ``compress`` is already folded
+    per step and leaf by ``grace_transform``, and ``seed`` decorrelates the
+    fault stream from the codec's own randomness.
+    """
+
+    inner: Compressor
+    nan_prob: float = 0.0
+    inf_prob: float = 0.0
+    bitflip_prob: float = 0.0
+    rank: Optional[int] = None
+    axis_name: str = "data"
+    seed: int = 0
+
+    # -- delegated compressor contract --------------------------------------
+    @property
+    def average(self):  # type: ignore[override]
+        return self.inner.average
+
+    @property
+    def tensors_size_are_same(self):  # type: ignore[override]
+        return self.inner.tensors_size_are_same
+
+    @property
+    def vote_aggregate(self):  # type: ignore[override]
+        return self.inner.vote_aggregate
+
+    @property
+    def summable_payload(self):  # type: ignore[override]
+        return self.inner.summable_payload
+
+    def init_state(self, x: jax.Array) -> State:
+        return self.inner.init_state(x)
+
+    def wire_nbytes(self, shape, dtype):
+        return self.inner.wire_nbytes(shape, dtype)
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        return self.inner.decompress(payload, ctx)
+
+    def aggregate(self, stacked: jax.Array) -> jax.Array:
+        return self.inner.aggregate(stacked)
+
+    # -- faulted encode ------------------------------------------------------
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        ckey = jax.random.fold_in(rng, self.seed)
+        gate = _gate(self.rank, self.axis_name)
+        if self.nan_prob:
+            khit, kpos, ckey = jax.random.split(ckey, 3)
+            hit = jax.random.bernoulli(khit, self.nan_prob) & gate
+            x = jnp.where(hit, _implant(x, kpos, jnp.nan), x)
+        if self.inf_prob:
+            khit, kpos, ckey = jax.random.split(ckey, 3)
+            hit = jax.random.bernoulli(khit, self.inf_prob) & gate
+            x = jnp.where(hit, _implant(x, kpos, jnp.inf), x)
+        payload, ctx, new_state = self.inner.compress(x, state, rng)
+        if self.bitflip_prob:
+            corrupted = []
+            for t in payload:
+                khit, kflip, ckey = jax.random.split(ckey, 3)
+                hit = jax.random.bernoulli(khit, self.bitflip_prob) & gate
+                corrupted.append(jnp.where(hit, _flip_one_bit(t, kflip), t))
+            payload = tuple(corrupted)
+        return payload, ctx, new_state
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosCommunicator(Communicator):
+    """Fault-injecting wrapper around any communicator.
+
+    Injects at the pipeline level, where the full 6-stage step is visible:
+    ``nan_prob``/``inf_prob`` poison the incoming per-rank gradient before
+    compensate/compress (the classic bad-batch fault), ``stale_prob`` drops
+    this step's memory-state update so the residual goes stale. The wrapped
+    communicator performs the actual exchange unchanged.
+    """
+
+    inner: Optional[Communicator] = None
+    nan_prob: float = 0.0
+    inf_prob: float = 0.0
+    stale_prob: float = 0.0
+    rank: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.inner is None:
+            raise TypeError("ChaosCommunicator requires inner=Communicator")
+        # Mirror the wrapped communicator's mesh axis so world_size() and
+        # rank gating agree with the collectives the inner one issues.
+        object.__setattr__(self, "axis_name", self.inner.axis_name)
+
+    def step(self, x: jax.Array, mem_state: State, comp_state: State,
+             memory: Memory, compressor: Compressor, rng: jax.Array
+             ) -> tuple[jax.Array, State, State]:
+        ckey = jax.random.fold_in(rng, self.seed)
+        gate = _gate(self.rank, self.axis_name)
+        if self.nan_prob:
+            khit, kpos, ckey = jax.random.split(ckey, 3)
+            hit = jax.random.bernoulli(khit, self.nan_prob) & gate
+            x = jnp.where(hit, _implant(x, kpos, jnp.nan), x)
+        if self.inf_prob:
+            khit, kpos, ckey = jax.random.split(ckey, 3)
+            hit = jax.random.bernoulli(khit, self.inf_prob) & gate
+            x = jnp.where(hit, _implant(x, kpos, jnp.inf), x)
+        out, new_mem, new_comp = self.inner.step(
+            x, mem_state, comp_state, memory, compressor, rng)
+        if self.stale_prob:
+            khit, ckey = jax.random.split(ckey)
+            stale = jax.random.bernoulli(khit, self.stale_prob) & gate
+            new_mem = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(stale, old, new),
+                mem_state, new_mem)
+        return out, new_mem, new_comp
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        return self.inner.exchange(payload, ctx, compressor)

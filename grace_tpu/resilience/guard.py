@@ -1,0 +1,162 @@
+"""In-graph non-finite step guard with error-feedback rollback.
+
+Error-feedback compression makes training *stateful*: a NaN/Inf that reaches
+a residual memory (``GraceState.mem``) is re-injected by ``compensate`` on
+every later step, so one bad batch permanently poisons EF-SignSGD/DGC/TopK
+runs. The GRACE reference has no defense, and ``optax.apply_if_finite`` is
+structurally unable to provide one here:
+
+* it inspects each rank's **local, pre-exchange** gradients — poison that
+  arrives *through the exchange* (another rank's payload, or overflow born
+  inside the codec arithmetic) is invisible to it, yet lands in this rank's
+  residual via ``memory.update``;
+* worse, under SPMD a local check can **disagree across ranks** (only the
+  faulty rank sees its NaN before the collective), so ranks would take
+  different branches around a collective — divergent state at best, a
+  collective deadlock at worst;
+* it knows nothing of ``GraceState``: it cannot re-route the exchange
+  through a dense path, and it cannot coordinate the rollback of residuals
+  with the rollback of downstream optimizer state.
+
+:func:`guard_transform` instead wraps the **whole** optax chain (grace
+transform + optimizer) and checks the **post-exchange** update pytree —
+which is rank-identical by construction, because the collective already
+mixed every rank's payload. On a bad step the entire inner state (params
+via zeroed updates, optimizer state, and every GraceState mem/comp leaf)
+rolls back **atomically** with ``jnp.where`` selects, so residuals never
+absorb a poisoned compensation. All of it is traced into the jitted step —
+no host round-trip, usable inside ``shard_map``.
+
+Degradation policy: ``fallback_after`` (K) consecutive bad steps flip the
+``fallback`` flag inside every GraceState (see
+:func:`grace_tpu.transform.set_fallback_flag`), routing the next
+``fallback_steps`` (M) exchanges through the dense escape hatch configured
+via ``grace_transform(escape=...)``; afterwards compression re-arms.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from grace_tpu.transform import set_fallback_flag
+
+__all__ = ["GuardState", "guard_transform"]
+
+
+class GuardState(NamedTuple):
+    inner: Any                    # wrapped chain's state (holds GraceState)
+    notfinite_count: jax.Array    # int32: total skipped (bad) steps
+    last_bad_step: jax.Array      # int32: step index of last bad step, -1
+    consecutive: jax.Array        # int32: current run of consecutive bad steps
+    fallback_remaining: jax.Array # int32: dense escape-hatch steps left
+    step: jax.Array               # int32: guard-local step counter
+
+
+def _nonfinite(tree) -> jax.Array:
+    """Scalar bool: any non-finite value in any inexact leaf of ``tree``."""
+    flags = [jnp.any(~jnp.isfinite(l))
+             for l in jax.tree_util.tree_leaves(tree)
+             if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact)]
+    if not flags:
+        return jnp.zeros((), jnp.bool_)
+    return jnp.stack(flags).any()
+
+
+def guard_transform(inner: optax.GradientTransformation,
+                    *,
+                    max_norm: Optional[float] = None,
+                    check_state: bool = True,
+                    fallback_after: Optional[int] = None,
+                    fallback_steps: Optional[int] = None,
+                    axis_name: Optional[str] = None
+                    ) -> optax.GradientTransformation:
+    """Wrap a full optax chain with the in-graph non-finite step guard.
+
+    Usage (the guard must wrap the WHOLE chain so grace residuals and
+    downstream optimizer state roll back together)::
+
+        tx = guard_transform(
+            optax.chain(grace_transform(comp, mem, communicator,
+                                        escape=FP16Compressor()),
+                        optax.sgd(0.1)),
+            fallback_after=3, fallback_steps=8, axis_name='data')
+
+    A step is **bad** when the final update pytree contains NaN/Inf, when
+    its global norm exceeds ``max_norm`` (if set), or — with ``check_state``
+    (default) — when any inexact leaf of the *new* inner state is
+    non-finite (catches poison that a saturating codec, e.g. a sign vote,
+    swallowed on the wire but still wrote into a residual). Bad steps emit
+    zero updates and keep the previous inner state bitwise; healthy steps
+    pass both through bitwise-unchanged, so an uninjected guarded run is
+    bit-identical to the unguarded one.
+
+    ``axis_name``: OR-reduce the bad flag over that mesh axis. The update
+    check alone is rank-identical already (post-exchange values are), but
+    ``check_state`` scans per-rank residuals, which CAN disagree across
+    ranks — set ``axis_name`` whenever the guard runs inside ``shard_map``
+    so every rank takes the same branch.
+
+    ``fallback_after``/``fallback_steps`` (K/M): after K consecutive bad
+    steps, set the GraceState ``fallback`` flag for the next M steps. The
+    flag only has an effect when the inner grace transform was built with
+    ``escape=...``; it is harmless otherwise.
+    """
+    if (fallback_after is None) != (fallback_steps is None):
+        raise ValueError("fallback_after (K) and fallback_steps (M) must be "
+                         "set together")
+    degrade = fallback_after is not None
+
+    def init(params) -> GuardState:
+        zero = jnp.zeros((), jnp.int32)
+        return GuardState(inner=inner.init(params),
+                          notfinite_count=zero,
+                          last_bad_step=zero - 1,
+                          consecutive=zero,
+                          fallback_remaining=zero,
+                          step=zero)
+
+    def update(updates, state: GuardState, params=None):
+        new_updates, new_inner = inner.update(updates, state.inner, params)
+
+        bad = _nonfinite(new_updates)
+        if max_norm is not None:
+            bad = bad | (optax.global_norm(new_updates) > max_norm)
+        if check_state:
+            bad = bad | _nonfinite(new_inner)
+        if axis_name is not None:
+            bad = lax.psum(bad.astype(jnp.int32), axis_name) > 0
+
+        # Atomic skip: zero updates + full inner-state rollback. where(False)
+        # selects the new value exactly, so healthy steps are bitwise clean.
+        rolled = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(bad, old, new),
+            state.inner, new_inner)
+        out_updates = jax.tree_util.tree_map(
+            lambda u: jnp.where(bad, jnp.zeros_like(u), u), new_updates)
+
+        bad_i = bad.astype(jnp.int32)
+        notfinite = state.notfinite_count + bad_i
+        last_bad = jnp.where(bad, state.step, state.last_bad_step)
+        consecutive = jnp.where(bad, state.consecutive + 1, 0)
+        # One dense step (if any) was consumed by the update that just ran.
+        active = (state.fallback_remaining > 0).astype(jnp.int32)
+        remaining = state.fallback_remaining - active
+        if degrade:
+            trip = (consecutive >= fallback_after) & (remaining == 0)
+            remaining = jnp.where(trip, fallback_steps, remaining)
+            consecutive = jnp.where(trip, 0, consecutive)
+        rolled = set_fallback_flag(rolled, remaining > 0)
+
+        return out_updates, GuardState(inner=rolled,
+                                       notfinite_count=notfinite,
+                                       last_bad_step=last_bad,
+                                       consecutive=consecutive,
+                                       fallback_remaining=remaining,
+                                       step=state.step + 1)
+
+    return optax.GradientTransformation(init, update)

@@ -16,6 +16,15 @@ grace_tpu/transform.py) carry a leading world axis sharded over the mesh.
 Always build states with :func:`init_train_state` /
 :func:`init_stateful_train_state` (passing the mesh) so the layout matches
 what the step functions expect.
+
+Resilience wiring: pass a guarded chain
+(``grace_tpu.resilience.guarded_chain(grace, optax.sgd(...), ...)``) as the
+``optimizer`` — nothing else changes. The guard's skip/rollback/fallback
+logic traces into the same jitted shard_map step (its ``GuardState`` rides
+inside ``opt_state``; ``partition_specs`` recurses through it to the
+GraceState leaves), and the loop reads health via
+``grace_tpu.utils.metrics.guard_report(state)`` / reacts via
+``grace_tpu.checkpoint.divergence_rollback``.
 """
 
 from __future__ import annotations
@@ -29,7 +38,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from grace_tpu.core import DEFAULT_AXIS
-from grace_tpu.parallel import replicated
+from grace_tpu.parallel import replicated, shard_map
 from grace_tpu.transform import (add_world_axis, partition_specs,
                                  strip_world_axis)
 
@@ -61,7 +70,7 @@ def _lazy_sharded_step(device_step, mesh: Mesh, axis_name: str, donate: bool):
         fn = cache.get(key)
         if fn is None:
             specs = partition_specs(state, axis_name)
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 device_step, mesh=mesh,
                 in_specs=(specs, P(axis_name)),
                 out_specs=(specs, P()),
@@ -153,7 +162,7 @@ def _init_opt_state(params: Any, optimizer: optax.GradientTransformation,
     leading world axis, sharded over ``axis_name``; the rest is replicated."""
     abstract = jax.eval_shape(optimizer.init, params)
     specs = partition_specs(abstract, axis_name)
-    init_fn = jax.shard_map(
+    init_fn = shard_map(
         lambda p: add_world_axis(optimizer.init(p)),
         mesh=mesh, in_specs=(P(),), out_specs=specs, check_vma=False)
     return jax.jit(init_fn)(params)
@@ -187,10 +196,19 @@ def warmup_schedule(base_lr: float, world_size: int, warmup_steps: int,
     to avoid early divergence. Returns an optax schedule; ``after(t)``
     optionally supplies the post-warmup schedule as a function of steps
     *since warmup end* (default: hold the scaled rate).
+
+    The boundary step belongs to the post-warmup schedule: ``count ==
+    warmup_steps`` returns ``after(0)``, not the warm ramp (pinned by
+    tests/test_resilience.py::test_warmup_boundary_handoff). And
+    ``warmup_steps=0`` means no warmup at all: ``after(count)`` from step
+    0, or the scaled rate if ``after`` is None.
     """
     scaled = base_lr * world_size
 
     def schedule(count):
+        if warmup_steps <= 0:
+            return (jnp.asarray(scaled, jnp.float32) if after is None
+                    else after(count))
         frac = jnp.minimum(count / jnp.maximum(warmup_steps, 1), 1.0)
         warm = base_lr + (scaled - base_lr) * frac
         if after is None:
@@ -214,7 +232,7 @@ def make_eval_step(metric_fn: Callable[[Any, Any], Any], mesh: Mesh,
         return jax.tree_util.tree_map(
             lambda m: lax.pmean(m, axis_name), metrics)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_eval, mesh=mesh,
         in_specs=(P(), P(axis_name)),
         out_specs=P(),

@@ -16,8 +16,10 @@ parameter's compensate→compress→update→exchange is traced into ONE XLA
 program — the reference's per-parameter Python loop over world_size × n_params
 decompressions (SURVEY.md §3.1 hot loop) disappears into the compiler.
 
-State layout: ``GraceState(count, rng_key, mem, comp)`` where ``mem``/``comp``
-are tuples aligned with the flattened gradient leaves. The rng key is
+State layout: ``GraceState(count, rng_key, mem, comp, fallback)`` where
+``mem``/``comp`` are tuples aligned with the flattened gradient leaves and
+``fallback`` is the replicated resilience health flag (see
+``grace_transform(escape=...)``). The rng key is
 replicated across ranks, so per-(step, leaf) keys derived via ``fold_in`` are
 rank-identical — the explicit contract RandomK/PowerSGD rely on (the
 reference relied on global-seed side effects, grace_dl/dist/compressor/
@@ -38,12 +40,13 @@ error feedback, not whichever replica the host happened to read.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 
 from grace_tpu.core import Communicator, Compressor, Memory, State
 
@@ -53,6 +56,11 @@ class GraceState(NamedTuple):
     rng_key: jax.Array        # replicated base key, stored as raw key data
     mem: Tuple[State, ...]    # per-leaf memory state, leaf order of tree_flatten
     comp: Tuple[State, ...]   # per-leaf compressor state
+    # Health flag (replicated): True routes the next update's exchange
+    # through the dense escape hatch (see grace_transform(escape=...)).
+    # Written by resilience.guard_transform via set_fallback_flag; plain
+    # grace_transform never sets it, so the default False is a no-op.
+    fallback: jax.Array = False
 
 
 def _is_grace(x) -> bool:
@@ -65,9 +73,8 @@ def _map_grace_varying(fn, tree):
 
     def per_node(node):
         if _is_grace(node):
-            return GraceState(node.count, node.rng_key,
-                              jax.tree_util.tree_map(fn, node.mem),
-                              jax.tree_util.tree_map(fn, node.comp))
+            return node._replace(mem=jax.tree_util.tree_map(fn, node.mem),
+                                 comp=jax.tree_util.tree_map(fn, node.comp))
         return node
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -108,10 +115,39 @@ def partition_specs(tree, axis_name: str):
                 jax.tree_util.tree_map(lambda _: P(), node.count),
                 jax.tree_util.tree_map(lambda _: P(), node.rng_key),
                 jax.tree_util.tree_map(lambda _: P(axis_name), node.mem),
-                jax.tree_util.tree_map(lambda _: P(axis_name), node.comp))
+                jax.tree_util.tree_map(lambda _: P(axis_name), node.comp),
+                jax.tree_util.tree_map(lambda _: P(), node.fallback))
         return jax.tree_util.tree_map(lambda _: P(), node)
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
+
+
+def set_fallback_flag(tree, active) -> Any:
+    """Write ``active`` into the ``fallback`` flag of every GraceState in
+    ``tree``. Used by :func:`grace_tpu.resilience.guard_transform` to route
+    the next step's exchange through the dense escape hatch; a no-op on
+    trees without GraceState nodes."""
+    active = jnp.asarray(active, jnp.bool_)
+
+    def per_node(node):
+        if _is_grace(node):
+            return node._replace(fallback=active)
+        return node
+
+    return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
+
+
+def fallback_flags(tree) -> list:
+    """The ``fallback`` flags of every GraceState in ``tree`` (leaf order)."""
+    flags = []
+
+    def per_node(node):
+        if _is_grace(node):
+            flags.append(node.fallback)
+        return node
+
+    jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
+    return flags
 
 
 def _bucketize(shapes_dtypes, bucket_bytes: Optional[int]):
@@ -140,7 +176,8 @@ def _bucketize(shapes_dtypes, bucket_bytes: Optional[int]):
 
 def grace_transform(compressor: Compressor, memory: Memory,
                     communicator: Communicator, seed: int = 0,
-                    fusion: Optional[int | str] = None
+                    fusion: Optional[int | str] = None,
+                    escape: Optional[Compressor] = None
                     ) -> optax.GradientTransformation:
     """Build the compressed-exchange transformation.
 
@@ -183,7 +220,23 @@ def grace_transform(compressor: Compressor, memory: Memory,
 
     Leaves are cast to their common result dtype inside a fused buffer and
     cast back on return.
+
+    ``escape`` (resilience escape hatch, no reference analog): a dense-safe
+    compressor (``NoneCompressor``/``FP16Compressor``) that, whenever the
+    state's ``fallback`` flag is set, replaces the whole compressed pipeline
+    for one step with ``escape``-encode → psum → decode over the same mesh
+    axis (classic dense all-reduce semantics) via `lax.cond` — mem/comp
+    state is left untouched, so compression resumes exactly where it left
+    off when the flag clears. The flag is driven by
+    :func:`grace_tpu.resilience.guard_transform`; without a guard it stays
+    False and the cond always takes the compressed branch.
     """
+    if escape is not None and not (getattr(escape, "summable_payload", False)
+                                   and escape.average):
+        raise ValueError(
+            "escape must be a dense, summable, averaging compressor "
+            "(NoneCompressor/FP16Compressor) — the escape hatch psums its "
+            f"payload; got {type(escape).__name__}.")
     if isinstance(fusion, str) and fusion not in ("flat", "grouped"):
         raise ValueError(f"fusion must be None, 'flat', 'grouped', or int "
                          f"bytes; got {fusion!r}")
@@ -226,19 +279,17 @@ def grace_transform(compressor: Compressor, memory: Memory,
         # state is plain-array checkpointable with any writer.
         return GraceState(count=jnp.zeros((), jnp.int32),
                           rng_key=jax.random.key_data(jax.random.key(seed)),
-                          mem=mem, comp=comp)
+                          mem=mem, comp=comp,
+                          fallback=jnp.zeros((), jnp.bool_))
 
-    def update(updates, state: GraceState, params=None):
-        del params
-        leaves, treedef = jax.tree_util.tree_flatten(updates)
-        base_key = jax.random.wrap_key_data(state.rng_key)
-        step_key = jax.random.fold_in(base_key, state.count)
+    def _run_compressed(operand):
+        leaves, mem, comp, step_key = operand
         new_mem, new_comp = [], []
         if grouped:
             groups = _group_views(leaves)
-            if len(state.mem) != len(groups):
+            if len(mem) != len(groups):
                 raise ValueError(
-                    f"grace state has {len(state.mem)} groups but the "
+                    f"grace state has {len(mem)} groups but the "
                     f"leaves form {len(groups)} — the state was built under "
                     "a different fusion setting. Re-init the optimizer "
                     "state (or restore a checkpoint written with the same "
@@ -253,17 +304,17 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     return communicator.step(g, ms, cs, memory, compressor,
                                              key)
 
-                out, ms, cs = jax.vmap(one)(stacked, state.mem[gi],
-                                            state.comp[gi], keys)
+                out, ms, cs = jax.vmap(one)(stacked, mem[gi],
+                                            comp[gi], keys)
                 for j, i in enumerate(idxs):
                     outs[i] = out[j]
                 new_mem.append(ms)
                 new_comp.append(cs)
         elif fused:
             buckets, cdtype = _bucket_views(leaves)
-            if len(state.mem) != len(buckets):
+            if len(mem) != len(buckets):
                 raise ValueError(
-                    f"grace state has {len(state.mem)} buffers but the "
+                    f"grace state has {len(mem)} buffers but the "
                     f"fusion plan has {len(buckets)} buckets — the state was "
                     "built under a different fusion setting. Re-init the "
                     "optimizer state (or restore a checkpoint written with "
@@ -274,7 +325,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 flat = jnp.concatenate([jnp.ravel(leaves[i]).astype(cdtype)
                                         for i in idxs])
                 out, ms, cs = communicator.step(
-                    flat, state.mem[b], state.comp[b], memory, compressor, rng)
+                    flat, mem[b], comp[b], memory, compressor, rng)
                 off = 0
                 for i in idxs:
                     shape = jnp.shape(leaves[i])
@@ -287,7 +338,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 new_comp.append(cs)
         else:
             outs = []
-            for i, (g, ms, cs) in enumerate(zip(leaves, state.mem, state.comp,
+            for i, (g, ms, cs) in enumerate(zip(leaves, mem, comp,
                                                 strict=True)):
                 rng = jax.random.fold_in(step_key, i)
                 out, ms, cs = communicator.step(g, ms, cs, memory, compressor,
@@ -295,8 +346,43 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 outs.append(out)
                 new_mem.append(ms)
                 new_comp.append(cs)
+        return tuple(outs), tuple(new_mem), tuple(new_comp)
+
+    def _run_dense(operand):
+        """Escape hatch: dense ``escape``-coded psum all-reduce of the raw
+        gradients; mem/comp pass through untouched so error feedback resumes
+        exactly where it paused when compression re-arms."""
+        from grace_tpu.comm import Allreduce
+
+        leaves, mem, comp, step_key = operand
+        allreduce = Allreduce(axis_name=communicator.axis_name)
+        outs = []
+        for i, g in enumerate(leaves):
+            rng = jax.random.fold_in(step_key, i)
+            payload, ctx, _ = escape.compress(g, escape.init_state(g), rng)
+            out = allreduce.exchange(payload, ctx, escape)
+            outs.append(out.astype(jnp.result_type(g)))
+        return tuple(outs), mem, comp
+
+    def update(updates, state: GraceState, params=None):
+        del params
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        base_key = jax.random.wrap_key_data(state.rng_key)
+        step_key = jax.random.fold_in(base_key, state.count)
+        operand = (tuple(leaves), state.mem, state.comp, step_key)
+        if escape is None:
+            outs, new_mem, new_comp = _run_compressed(operand)
+        else:
+            # Both branches carry collectives; the predicate is replicated
+            # (the guard derives it from rank-identical post-exchange
+            # updates, OR-reduced over the axis), so every rank takes the
+            # same branch and the collectives rendezvous.
+            outs, new_mem, new_comp = lax.cond(
+                jnp.asarray(state.fallback, jnp.bool_),
+                _run_dense, _run_compressed, operand)
         new_state = GraceState(count=state.count + 1, rng_key=state.rng_key,
-                               mem=tuple(new_mem), comp=tuple(new_comp))
+                               mem=new_mem, comp=new_comp,
+                               fallback=state.fallback)
         return jax.tree_util.tree_unflatten(treedef, outs), new_state
 
     return optax.GradientTransformation(init, update)

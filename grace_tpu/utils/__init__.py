@@ -10,18 +10,18 @@ these to a framework module and adds what the reference never measured:
 per-algorithm bytes-on-wire / compression-ratio accounting (`wire_report`).
 """
 
-from grace_tpu.utils.logging import (TableLogger, Timer, TSVLogger, localtime,
-                                     rank_zero_only, rank_zero_print,
-                                     run_provenance)
+from grace_tpu.utils.logging import (GuardMonitor, TableLogger, Timer,
+                                     TSVLogger, localtime, rank_zero_only,
+                                     rank_zero_print, run_provenance)
 from grace_tpu.utils.metrics import (CompressionReport, LeafReport,
-                                     debug_nan_residuals, payload_nbytes,
-                                     wire_report)
+                                     debug_nan_residuals, guard_report,
+                                     payload_nbytes, wire_report)
 from grace_tpu.utils.profiling import StepTimer, trace
 
 __all__ = [
-    "TableLogger", "TSVLogger", "Timer", "localtime",
+    "GuardMonitor", "TableLogger", "TSVLogger", "Timer", "localtime",
     "rank_zero_only", "rank_zero_print", "run_provenance",
     "CompressionReport", "LeafReport", "debug_nan_residuals",
-    "payload_nbytes", "wire_report",
+    "guard_report", "payload_nbytes", "wire_report",
     "StepTimer", "trace",
 ]

@@ -23,7 +23,7 @@ from typing import Callable, Mapping, Optional, Sequence, TextIO
 
 import jax
 
-__all__ = ["Timer", "TableLogger", "TSVLogger", "localtime",
+__all__ = ["Timer", "TableLogger", "TSVLogger", "GuardMonitor", "localtime",
            "rank_zero_only", "rank_zero_print", "run_provenance"]
 
 
@@ -131,6 +131,40 @@ class TSVLogger:
     def write(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(str(self) + "\n")
+
+
+class GuardMonitor:
+    """Emit guard-state *transitions*: skipped steps, fallback open/close.
+
+    Feed it the per-step dict from ``grace_tpu.utils.metrics.guard_report``;
+    it prints (rank-0 only, via :func:`rank_zero_print` by default) only
+    when something changed, so a healthy run stays silent::
+
+        mon = GuardMonitor()
+        for i, batch in enumerate(batches):
+            state, loss = step(state, batch)
+            mon.update(i, guard_report(state))
+    """
+
+    def __init__(self, printer: Optional[Callable[..., None]] = None):
+        self._print = printer or rank_zero_print
+        self._last: Optional[dict] = None
+
+    def update(self, step: int, report: Mapping[str, object]) -> None:
+        if not report:
+            return
+        prev, self._last = self._last, dict(report)
+        if prev is None:
+            return
+        if report["notfinite_count"] > prev["notfinite_count"]:
+            self._print(f"[guard] step {step}: non-finite/exploding update "
+                        f"skipped (total={report['notfinite_count']}, "
+                        f"consecutive={report['consecutive']})")
+        if report["fallback_active"] and not prev["fallback_active"]:
+            self._print(f"[guard] step {step}: dense fallback engaged for "
+                        f"{report['fallback_remaining']} steps")
+        if prev["fallback_active"] and not report["fallback_active"]:
+            self._print(f"[guard] step {step}: compression re-armed")
 
 
 def run_provenance(data: str, **extra: object) -> dict:

@@ -23,7 +23,8 @@ import numpy as np
 
 from grace_tpu.core import Compressor
 
-__all__ = ["LeafReport", "CompressionReport", "payload_nbytes", "wire_report"]
+__all__ = ["LeafReport", "CompressionReport", "payload_nbytes", "wire_report",
+           "guard_report"]
 
 
 def _nbytes(shaped) -> int:
@@ -105,6 +106,43 @@ def wire_report(compressor: Compressor, grads: Any) -> CompressionReport:
         leaves.append(LeafReport(path=jax.tree_util.keystr(path),
                                  dense_bytes=dense, wire_bytes=wire))
     return CompressionReport(leaves=tuple(leaves))
+
+
+def guard_report(state: Any) -> Dict[str, Any]:
+    """Host-side health summary of the non-finite step guard in ``state``.
+
+    Walks any state pytree (e.g. a ``TrainState``) for the
+    :class:`~grace_tpu.resilience.guard.GuardState` that
+    ``guard_transform`` threads through the optimizer state, and returns::
+
+        {"step", "notfinite_count", "last_bad_step", "consecutive",
+         "fallback_remaining", "fallback_active"}
+
+    in one device-to-host transfer — the counters a training loop logs per
+    step (see ``grace_tpu.utils.logging.GuardMonitor``) and feeds into
+    save-time health decisions (``Checkpointer.save(..., good=...)``).
+    Empty dict when no guard is present.
+    """
+    from grace_tpu.resilience.guard import GuardState
+
+    found: list = []
+
+    def walk(node):
+        if isinstance(node, GuardState):
+            found.append(node)
+        return node
+
+    jax.tree_util.tree_map(walk, state,
+                           is_leaf=lambda n: isinstance(n, GuardState))
+    if not found:
+        return {}
+    g = found[0]
+    nf, lb, cs, fr, st = (int(v) for v in jax.device_get(
+        [g.notfinite_count, g.last_bad_step, g.consecutive,
+         g.fallback_remaining, g.step]))
+    return {"step": st, "notfinite_count": nf, "last_bad_step": lb,
+            "consecutive": cs, "fallback_remaining": fr,
+            "fallback_active": fr > 0}
 
 
 def debug_nan_residuals(state: Any) -> Dict[str, int]:

@@ -17,12 +17,18 @@ import jax  # noqa: E402
 # TPU tunnel before this file runs, so setting env vars is not enough —
 # override via config (legal until the first backend initializes).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 from grace_tpu.parallel import (data_parallel_mesh,  # noqa: E402
-                                relax_cpu_collective_timeouts)
+                                relax_cpu_collective_timeouts,
+                                set_cpu_device_count)
+
+# JAX >= 0.4.38 spells this as the jax_num_cpu_devices config option; on
+# older JAX (e.g. 0.4.37) the helper falls back to XLA_FLAGS, which is
+# still effective here because the CPU backend has not initialized yet
+# (nothing above touches jax.devices()).
+set_cpu_device_count(8)
 
 # 8 device threads on a possibly 1-core host: don't let XLA's 40s collective
 # rendezvous terminate-timeout kill a slow-but-healthy test step.

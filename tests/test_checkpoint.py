@@ -118,3 +118,85 @@ class TestCheckpointRoundTrip:
     def test_restore_missing_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError):
             restore_checkpoint(tmp_path / "nothing", {"x": jnp.zeros(2)})
+
+
+class TestStructureMismatch:
+    """Resume-after-config-change must fail with a named leaf path, not a
+    raw orbax traceback (ISSUE 1 satellite)."""
+
+    def test_extra_target_leaf_named(self, tmp_path):
+        state = {"params": {"w": jnp.ones((4, 2)), "b": jnp.zeros(2)}}
+        save_checkpoint(tmp_path / "c", state, step=1)
+        bad_target = {"params": {"w": jnp.ones((4, 2)), "b": jnp.zeros(2),
+                                 "momentum": jnp.zeros(2)}}
+        with pytest.raises(ValueError, match="params/momentum"):
+            restore_checkpoint(tmp_path / "c", bad_target)
+
+    def test_missing_target_leaf_named(self, tmp_path):
+        state = {"params": {"w": jnp.ones((4, 2))}, "extra": jnp.zeros(3)}
+        save_checkpoint(tmp_path / "c", state, step=1)
+        with pytest.raises(ValueError, match="extra"):
+            restore_checkpoint(tmp_path / "c",
+                               {"params": {"w": jnp.ones((4, 2))}})
+
+    def test_train_state_optimizer_change_named(self, mesh, tmp_path):
+        """The real-world case: checkpoint written with sgd, restored into
+        an adam-shaped state — error names a grace/optimizer leaf."""
+        import optax
+
+        from grace_tpu.train import init_train_state
+
+        grc = grace_from_params({"compressor": "topk", "compress_ratio": 0.1,
+                                 "memory": "residual",
+                                 "communicator": "allgather"})
+        params = {"w": jnp.ones((16, 4))}
+        sgd_state = init_train_state(
+            params, optax.chain(grc.transform(), optax.sgd(1e-2)), mesh)
+        adam_state = init_train_state(
+            params, optax.chain(grc.transform(), optax.adam(1e-2)), mesh)
+        save_checkpoint(tmp_path / "c", sgd_state, step=1)
+        with pytest.raises(ValueError, match="structure mismatch|restore"):
+            restore_checkpoint(tmp_path / "c", adam_state)
+
+
+class TestLastKnownGood:
+    def test_restore_last_good_picks_newest_good(self, tmp_path):
+        with Checkpointer(tmp_path / "g", max_to_keep=None) as ckpt:
+            for s, good in ((1, True), (2, True), (3, False), (4, None)):
+                ckpt.save(s, {"x": jnp.full((2,), float(s))}, force=True,
+                          good=good)
+            ckpt.wait()
+            assert ckpt.latest_step() == 4
+            assert ckpt.last_good_step() == 2
+            restored = ckpt.restore_last_good({"x": jnp.zeros(2)})
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      [2.0, 2.0])
+
+    def test_good_mark_can_be_revoked(self, tmp_path):
+        with Checkpointer(tmp_path / "r", max_to_keep=None) as ckpt:
+            ckpt.save(1, {"x": jnp.ones(2)}, force=True, good=True)
+            ckpt.mark_good(1, False)   # e.g. post-hoc eval found divergence
+            ckpt.wait()
+            assert ckpt.last_good_step() is None
+            with pytest.raises(FileNotFoundError):
+                ckpt.restore_last_good({"x": jnp.zeros(2)})
+
+    def test_good_record_survives_reopen(self, tmp_path):
+        with Checkpointer(tmp_path / "p", max_to_keep=None) as ckpt:
+            ckpt.save(7, {"x": jnp.ones(2)}, force=True, good=True)
+            ckpt.wait()
+        with Checkpointer(tmp_path / "p", max_to_keep=None) as ckpt:
+            assert ckpt.last_good_step() == 7
+
+    def test_retention_gc_prunes_good_steps(self, tmp_path):
+        """A good step garbage-collected by max_to_keep must not be offered
+        for rollback."""
+        with Checkpointer(tmp_path / "gc", max_to_keep=2) as ckpt:
+            ckpt.save(1, {"x": jnp.ones(2)}, force=True, good=True)
+            for s in (2, 3):
+                ckpt.save(s, {"x": jnp.full((2,), float(s))}, force=True,
+                          good=False)
+            ckpt.wait()
+            steps = set(ckpt.all_steps())
+            if 1 not in steps:        # retention kicked in
+                assert ckpt.last_good_step() is None

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from grace_tpu.parallel import shard_map
 from grace_tpu import comm
 from grace_tpu import compressors as C
 
@@ -24,7 +25,7 @@ def run_exchange(mesh, communicator, compressor, per_rank, state=None, seed=0):
         payload, ctx, _ = compressor.compress(x, st, jax.random.key(seed))
         return communicator.exchange(payload, ctx, compressor)[None]
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("data"),
                        out_specs=P("data"), check_vma=False)
     return np.asarray(fn(per_rank)[0])
 
@@ -210,7 +211,7 @@ def run_step(mesh, communicator, compressor, memory, per_rank, seed=0):
         ms_leaf = ms if ms is not None else jnp.zeros_like(x)
         return out[None], ms_leaf[None]
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("data"),
                        out_specs=(P("data"), P("data")), check_vma=False)
     out, ms = fn(per_rank)
     return np.asarray(out[0]), np.asarray(ms[0])
@@ -329,7 +330,7 @@ class TestTwoShotAllreduce:
                     total = total + out
                 return total[None]
 
-            fn = jax.shard_map(body, mesh=mesh, in_specs=P(None, "data"),
+            fn = shard_map(body, mesh=mesh, in_specs=P(None, "data"),
                                out_specs=P("data"), check_vma=False)
             return np.asarray(fn(jnp.asarray(grads))[0]), grads
 

@@ -16,7 +16,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from grace_tpu import grace_from_params
-from grace_tpu.parallel import data_parallel_mesh
+from grace_tpu.parallel import data_parallel_mesh, shard_map
 
 W = 8
 
@@ -40,7 +40,7 @@ def build_step(grc, mesh, lr=0.1):
             new_params[name] = params[name] - lr * out
         return new_params, new_mem
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         device_step, mesh=mesh,
         in_specs=(P(), P("data"), P("data"), P()),
         out_specs=(P(), P("data")), check_vma=False))

@@ -32,7 +32,8 @@ _WORKER = r'''
 import sys
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)   # 2 local -> 4 global devices
+from grace_tpu.parallel import set_cpu_device_count
+set_cpu_device_count(2)   # 2 local -> 4 global devices
 
 port, pid = sys.argv[1], int(sys.argv[2])
 from grace_tpu.parallel import (broadcast_tree, data_parallel_mesh,

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from grace_tpu.parallel import shard_map
 from grace_tpu.comm import Identity
 from grace_tpu.compressors import TopKCompressor
 from grace_tpu.memories import EFSignSGDMemory, ResidualMemory
@@ -28,7 +29,7 @@ def _step(compressor, memory, x, resid, rng):
     def body(x, resid):
         return comm.step(x, resid, None, memory, compressor, rng)[:2]
 
-    return jax.shard_map(body, mesh=mesh, in_specs=(P(), P()),
+    return shard_map(body, mesh=mesh, in_specs=(P(), P()),
                          out_specs=(P(), P()), check_vma=False)(x, resid)
 
 

@@ -1,0 +1,453 @@
+"""Resilience: in-graph non-finite guard, dense fallback, chaos, rollback.
+
+The properties pinned here are the acceptance criteria of the resilience
+subsystem (ISSUE 1): atomic in-graph skip (params AND every GraceState
+mem/comp leaf bitwise-unchanged across a poisoned step, on all ranks),
+zero overhead when healthy (bit-identity with the unguarded run), the
+K-consecutive→M-step dense fallback window, and kill-and-resume via
+``restore_last_good`` reproducing the uninterrupted trajectory.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from grace_tpu import grace_from_params
+from grace_tpu.checkpoint import Checkpointer, divergence_rollback
+from grace_tpu.resilience import (ChaosCommunicator, ChaosCompressor,
+                                  guard_transform, guarded_chain)
+from grace_tpu.resilience.chaos import _flip_one_bit, _implant
+from grace_tpu.train import init_train_state, make_train_step
+from grace_tpu.utils.logging import GuardMonitor
+from grace_tpu.utils.metrics import guard_report
+
+BATCH, DIM, CLASSES = 64, 20, 4
+
+TOPK_EF = {"compressor": "topk", "compress_ratio": 0.3,
+           "memory": "residual", "communicator": "allgather",
+           "escape": "fp16"}
+
+
+def _problem(seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(DIM, CLASSES)).astype(np.float32)
+    x = rng.normal(size=(BATCH * 8, DIM)).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    logits = x @ params["w"] + params["b"]
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _init_params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(
+                rng.normal(size=(DIM, CLASSES)).astype(np.float32) * 0.1),
+            "b": jnp.zeros((CLASSES,), jnp.float32)}
+
+
+def _build(mesh, grace_params=TOPK_EF, lr=0.3, chaos=None, **guard_kw):
+    grc = grace_from_params(dict(grace_params))
+    if chaos is not None:
+        grc = dataclasses.replace(
+            grc, communicator=chaos(grc.communicator))
+    tx = guarded_chain(grc, optax.sgd(lr), **guard_kw)
+    state = init_train_state(_init_params(), tx, mesh)
+    step = make_train_step(_loss_fn, tx, mesh, donate=False)
+    return state, step
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _grace_of(state):
+    return state.opt_state.inner[0]   # guard(chain(grace, sgd)) layout
+
+
+# ---------------------------------------------------------------------------
+# guard: atomic skip
+# ---------------------------------------------------------------------------
+
+def test_single_rank_nan_skips_step_atomically(mesh):
+    """NaN in ONE rank's local gradient (only rank 0's batch shard is
+    poisoned) → post-exchange updates are NaN on all ranks → the step is
+    skipped atomically: params and every mem/comp leaf (the state arrays
+    span all ranks via the world axis) stay bitwise-identical."""
+    x, y = _problem()
+    state, step = _build(mesh)
+    for _ in range(3):
+        state, loss = step(state, (x, y))
+    assert np.isfinite(float(loss))
+    before = state
+
+    xbad = np.asarray(x).copy()
+    xbad[0, 0] = np.nan          # rows 0..63 = rank 0's shard only
+    state, _ = step(state, (jnp.asarray(xbad), y))
+
+    rep = guard_report(state)
+    assert rep["notfinite_count"] == 1
+    assert rep["last_bad_step"] == 3
+    assert _leaves_equal(before.params, state.params)
+    g0, g1 = _grace_of(before), _grace_of(state)
+    assert _leaves_equal(g0.mem, g1.mem)
+    assert _leaves_equal(g0.comp, g1.comp)
+    assert _leaves_equal(g0.count, g1.count)
+
+    # clean data → training resumes from the unpoisoned state
+    state, loss = step(state, (x, y))
+    assert np.isfinite(float(loss))
+    assert not _leaves_equal(before.params, state.params)
+    assert guard_report(state)["notfinite_count"] == 1
+
+
+def test_guard_zero_overhead_when_healthy(mesh):
+    """Uninjected runs — plain, escape-armed, and fully guarded — must be
+    BIT-identical: jnp.where(False, old, new) and the untaken cond branch
+    may not perturb a single value."""
+    x, y = _problem()
+
+    def run(grace_params, guard):
+        grc = grace_from_params(dict(grace_params))
+        if guard:
+            tx = guarded_chain(grc, optax.sgd(0.3),
+                               fallback_after=3, fallback_steps=4)
+        else:
+            tx = optax.chain(grc.transform(seed=0), optax.sgd(0.3))
+        state = init_train_state(_init_params(), tx, mesh)
+        step = make_train_step(_loss_fn, tx, mesh, donate=False)
+        for _ in range(6):
+            state, loss = step(state, (x, y))
+        return state.params, float(loss)
+
+    plain = dict(TOPK_EF)
+    plain.pop("escape")
+    p0, l0 = run(plain, guard=False)     # no escape, no guard
+    p1, l1 = run(TOPK_EF, guard=False)   # escape cond present, flag False
+    p2, l2 = run(TOPK_EF, guard=True)    # full guard
+    assert l0 == l1 == l2
+    assert _leaves_equal(p0, p1)
+    assert _leaves_equal(p1, p2)
+
+
+def test_guard_max_norm_bound():
+    """Norm-explosion guard, single device (no mesh axis bound)."""
+    tx = guard_transform(optax.sgd(1.0), max_norm=1.0, axis_name=None)
+    params = {"w": jnp.ones((4,))}
+    st = tx.init(params)
+    upd, st = tx.update({"w": jnp.full((4,), 100.0)}, st, params)
+    assert int(st.notfinite_count) == 1
+    assert float(jnp.abs(upd["w"]).max()) == 0.0
+    upd, st = tx.update({"w": jnp.full((4,), 0.01)}, st, params)
+    assert int(st.notfinite_count) == 1
+    np.testing.assert_allclose(np.asarray(upd["w"]), -0.01, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# chaos injectors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_single_rank_nan_freezes_state(mesh):
+    """Chaos NaN on exactly one mesh index, every step: every step skips,
+    nothing (params, mem, comp) moves, on any rank."""
+    x, y = _problem()
+    state, step = _build(
+        mesh, chaos=lambda inner: ChaosCommunicator(
+            inner=inner, nan_prob=1.0, rank=3, seed=7))
+    init_state = state
+    for i in range(5):
+        state, _ = step(state, (x, y))
+    rep = guard_report(state)
+    assert rep["notfinite_count"] == 5
+    assert rep["consecutive"] == 5
+    assert _leaves_equal(init_state.params, state.params)
+    g0, g1 = _grace_of(init_state), _grace_of(state)
+    assert _leaves_equal(g0.mem, g1.mem)
+    assert _leaves_equal(g0.comp, g1.comp)
+
+
+@pytest.mark.chaos
+def test_fallback_window_engages_and_rearms(mesh):
+    """K=3 consecutive bad steps → dense escape hatch for exactly M=4
+    steps (health flag set, training progresses because the dense path
+    bypasses the compressed pipeline the fault lives in) → compression
+    re-arms → faults bite again."""
+    K, M = 3, 4
+    x, y = _problem()
+    state, step = _build(
+        mesh, chaos=lambda inner: ChaosCommunicator(
+            inner=inner, nan_prob=1.0, rank=0, seed=7),
+        fallback_after=K, fallback_steps=M)
+
+    flags, losses, nf = [], [], []
+    for i in range(16):
+        state, loss = step(state, (x, y))
+        rep = guard_report(state)
+        flags.append(bool(np.asarray(_grace_of(state).fallback)))
+        losses.append(float(loss))
+        nf.append(rep["notfinite_count"])
+
+    # Steps 0..K-1 bad; trip at the end of step K-1 arms the flag for the
+    # next M steps; at the end of the window the flag drops and the
+    # compressed (faulted) pipeline trips again exactly K steps later.
+    assert nf[:K] == list(range(1, K + 1))
+    assert flags[:K] == [False] * (K - 1) + [True]
+    assert flags[K - 1:K - 1 + M] == [True] * M          # exactly M dense
+    assert flags[K - 1 + M] is False                     # re-armed
+    assert nf[K - 1 + M - 1] == K                        # no skips in window
+    assert nf[2 * K + M - 1] == 2 * K                    # second trip
+    # dense window made real progress, and the run stays finite throughout
+    assert losses[K + M] < losses[K]
+    assert all(np.isfinite(l) for l in losses[2:])
+
+
+@pytest.mark.chaos
+def test_chaos_is_deterministic(mesh):
+    """Same chaos seed → bit-identical fault pattern and trajectory."""
+    x, y = _problem()
+
+    def run(seed):
+        state, step = _build(
+            mesh, chaos=lambda inner: ChaosCommunicator(
+                inner=inner, nan_prob=0.25, rank=2, seed=seed))
+        losses = []
+        for _ in range(8):
+            state, loss = step(state, (x, y))
+            losses.append(float(loss))
+        return losses, guard_report(state)["notfinite_count"]
+
+    la, ca = run(12)
+    lb, cb = run(12)
+    assert ca == cb
+    assert la == lb           # float-exact: same faults, same math
+    assert 0 < ca < 8         # this seed hits some steps, misses others
+
+
+def test_implant_and_bitflip_primitives():
+    key = jax.random.key(0)
+    x = jnp.zeros((13,), jnp.float32)
+    nanned = _implant(x, key, jnp.nan)
+    assert int(jnp.isnan(nanned).sum()) == 1
+
+    t = jax.random.normal(jax.random.key(1), (64,), jnp.float32)
+    flipped = _flip_one_bit(t, key)
+    a = np.asarray(jax.lax.bitcast_convert_type(t, jnp.uint32))
+    b = np.asarray(jax.lax.bitcast_convert_type(flipped, jnp.uint32))
+    xor = a ^ b
+    assert (xor != 0).sum() == 1                      # one element touched
+    assert bin(int(xor[xor != 0][0])).count("1") == 1  # by exactly one bit
+
+
+@pytest.mark.chaos
+def test_stale_residual_fault(mesh):
+    """stale_prob=1 suppresses the residual update: memory replays last
+    step's state instead of accumulating this step's compression error."""
+    from jax.sharding import PartitionSpec as P
+
+    from grace_tpu.comm import Allgather
+    from grace_tpu.compressors import TopKCompressor
+    from grace_tpu.memories import ResidualMemory
+    from grace_tpu.parallel import shard_map
+
+    comp = TopKCompressor(compress_ratio=0.25)
+    memory = ResidualMemory()
+    clean = Allgather()
+    stale = ChaosCommunicator(inner=Allgather(), stale_prob=1.0, seed=3)
+
+    g = jnp.asarray(np.linspace(-1, 1, 8 * 16, dtype=np.float32)
+                    .reshape(8, 16))
+
+    def body(comm, gg):
+        gg = gg[0]
+        out, mem, _ = comm.step(gg, memory.init_state(gg),
+                                comp.init_state(gg), memory, comp,
+                                jax.random.key(0))
+        return out[None], mem[None]
+
+    def run(comm):
+        fn = shard_map(lambda gg: body(comm, gg), mesh=mesh,
+                       in_specs=P("data"), out_specs=(P("data"), P("data")),
+                       check_vma=False)
+        return fn(g)
+
+    out_clean, mem_clean = run(clean)
+    out_stale, mem_stale = run(stale)
+    # the exchange itself is untouched...
+    np.testing.assert_array_equal(np.asarray(out_clean),
+                                  np.asarray(out_stale))
+    # ...but the stale run kept the initial (zero) residual
+    assert float(jnp.abs(mem_clean).sum()) > 0
+    assert float(jnp.abs(mem_stale).sum()) == 0
+
+
+@pytest.mark.chaos
+def test_chaos_compressor_payload_bitflip(mesh):
+    """Payload bit-flips corrupt the wire but not the codec semantics: the
+    decompressed aggregate differs from the clean run while the clean
+    pipeline (bitflip_prob=0) is bit-identical to the unwrapped one."""
+    from jax.sharding import PartitionSpec as P
+
+    from grace_tpu.comm import Allgather
+    from grace_tpu.compressors import NoneCompressor
+    from grace_tpu.memories import NoneMemory
+    from grace_tpu.parallel import shard_map
+
+    memory = NoneMemory()
+    g = jnp.asarray(np.linspace(-1, 1, 8 * 32, dtype=np.float32)
+                    .reshape(8, 32))
+
+    def run(comp):
+        def body(gg):
+            gg = gg[0]
+            out, _, _ = Allgather().step(gg, None, None, memory, comp,
+                                         jax.random.key(5))
+            return out[None]
+
+        fn = shard_map(body, mesh=mesh, in_specs=P("data"),
+                       out_specs=P("data"), check_vma=False)
+        return np.asarray(fn(g))
+
+    base = run(NoneCompressor())
+    wrapped_clean = run(ChaosCompressor(inner=NoneCompressor()))
+    flipped = run(ChaosCompressor(inner=NoneCompressor(),
+                                  bitflip_prob=1.0, seed=9))
+    np.testing.assert_array_equal(base, wrapped_clean)
+    assert not np.array_equal(base, flipped)
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume / divergence rollback
+# ---------------------------------------------------------------------------
+
+def test_kill_and_resume_matches_uninterrupted(mesh, tmp_path):
+    """Crash after step 6, restore_last_good, replay — per-leaf identical
+    to the run that never died (residual state is part of the checkpoint,
+    so the trajectories coincide exactly)."""
+    x, y = _problem()
+    state, step = _build(mesh)
+
+    with Checkpointer(tmp_path / "ck", max_to_keep=None) as ckpt:
+        for i in range(6):
+            state, loss = step(state, (x, y))
+            rep = guard_report(state)
+            ckpt.save(i, state, force=True,
+                      good=np.isfinite(float(loss))
+                      and rep["consecutive"] == 0)
+        ckpt.wait()
+        assert ckpt.last_good_step() == 5
+
+        cont = state
+        cont_losses = []
+        for i in range(4):
+            cont, loss = step(cont, (x, y))
+            cont_losses.append(float(loss))
+
+        resumed = ckpt.restore_last_good(state)
+        res_losses = []
+        for i in range(4):
+            resumed, loss = step(resumed, (x, y))
+            res_losses.append(float(loss))
+
+    assert res_losses == cont_losses
+    for a, b in zip(jax.tree_util.tree_leaves(cont.params),
+                    jax.tree_util.tree_leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=0)
+
+
+def test_divergence_rollback_skips_data_window(mesh, tmp_path):
+    x, y = _problem()
+    state, step = _build(mesh)
+    with Checkpointer(tmp_path / "dr", max_to_keep=None) as ckpt:
+        snapshots = {}
+        for i in range(4):
+            state, loss = step(state, (x, y))
+            ckpt.save(i, state, force=True, good=(i <= 2))
+            snapshots[i] = state
+        ckpt.wait()
+        restored, good_step, resume_at = divergence_rollback(
+            ckpt, state, failed_step=7, skip_window=3)
+    assert good_step == 2
+    assert resume_at == 10
+    assert _leaves_equal(snapshots[2], restored)
+
+
+def test_guard_report_and_monitor(mesh):
+    x, y = _problem()
+    state, step = _build(mesh, fallback_after=2, fallback_steps=2)
+    assert guard_report({"not": "a guard state"}) == {}
+
+    lines = []
+    mon = GuardMonitor(printer=lambda *a: lines.append(" ".join(map(str, a))))
+    xbad = np.asarray(x).copy()
+    xbad[0, 0] = np.nan
+    batches = [x, xbad, xbad, x, x, x]   # 2 consecutive bad → trip (K=2)
+    for i, xb in enumerate(batches):
+        state, _ = step(state, (jnp.asarray(xb), y))
+        mon.update(i, guard_report(state))
+    rep = guard_report(state)
+    assert rep["notfinite_count"] == 2
+    assert not rep["fallback_active"]    # window (M=2) opened and closed
+    assert any("skipped" in l for l in lines)
+    assert any("fallback engaged" in l for l in lines)
+    assert any("re-armed" in l for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# warmup boundary (regression pin)
+# ---------------------------------------------------------------------------
+
+def test_warmup_boundary_handoff():
+    """count == warmup_steps must hand off to after(0), not the warm ramp."""
+    from grace_tpu.train import warmup_schedule
+
+    marker = 0.123
+    sched = warmup_schedule(0.1, 8, warmup_steps=5,
+                            after=lambda t: marker + 0.01 * t)
+    np.testing.assert_allclose(float(sched(5)), marker, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(7)), marker + 0.02, rtol=1e-6)
+    # ramp: base at 0, base + (scaled-base) * 4/5 one step before the end
+    np.testing.assert_allclose(float(sched(0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(4)), 0.1 + 0.7 * 4 / 5, rtol=1e-6)
+    # degenerate warmup: scaled (or after(count)) from step 0
+    np.testing.assert_allclose(float(warmup_schedule(0.1, 8, 0)(0)), 0.8,
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        float(warmup_schedule(0.1, 8, 0, after=lambda t: marker + 1.0 * t)(2)),
+        marker + 2.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# long soak (slow, excluded from tier-1)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_soak_low_rate_injection_converges(mesh):
+    """1.5% per-(step,leaf) NaN injection over 120 steps: the guard keeps
+    the run finite and training still makes progress."""
+    x, y = _problem()
+    state, step = _build(
+        mesh, chaos=lambda inner: ChaosCommunicator(
+            inner=inner, nan_prob=0.015, rank=1, seed=13),
+        fallback_after=3, fallback_steps=8)
+    first = None
+    for _ in range(120):
+        state, loss = step(state, (x, y))
+        if first is None:
+            first = float(loss)
+    rep = guard_report(state)
+    assert np.isfinite(float(loss))
+    assert float(loss) < first
+    assert rep["notfinite_count"] >= 1
