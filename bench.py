@@ -755,8 +755,21 @@ def _write_evidence(rows: list, path: str, metric: str, n_expected: int,
     import datetime
     comp = next((r for r in rows if r.get("config") == headline_config
                  and value_key in r), None)
+    try:
+        # Same provenance block the telemetry JSONL artifacts carry
+        # (platform/devices/UTC/git commit) so every evidence file is
+        # attributable to a revision. Best-effort: evidence persistence
+        # must survive a broken git checkout.
+        from grace_tpu.utils.logging import run_provenance
+        provenance = run_provenance(data="synthetic",
+                                    tool="bench", argv=" ".join(sys.argv[1:]))
+    except Exception as e:
+        print(f"[bench] provenance unavailable: {e}",
+              file=sys.stderr, flush=True)
+        provenance = None
     rec = {
         "metric": metric,
+        "provenance": provenance,
         "value": comp[value_key] if comp else None,
         "unit": value_key.replace("_per_sec", "/sec").replace("_", " "),
         "vs_baseline": comp["vs_baseline"] if comp else None,

@@ -15,6 +15,9 @@ from grace_tpu.comm import (Allgather, Allreduce, Broadcast, Identity,
 from grace_tpu.helper import Grace, grace_from_params
 from grace_tpu.resilience import (ChaosCommunicator, ChaosCompressor,
                                   GuardState, guard_transform, guarded_chain)
+from grace_tpu.telemetry import (JSONLSink, MultiSink, TelemetryConfig,
+                                 TelemetryReader, TelemetryState,
+                                 TensorBoardSink, trace_stage)
 from grace_tpu.transform import GraceState, grace_transform
 from grace_tpu.train import (TrainState, init_train_state, make_eval_step,
                              make_train_step)
@@ -29,6 +32,8 @@ __all__ = [
     "Grace", "grace_from_params", "grace_transform", "GraceState",
     "GuardState", "guard_transform", "guarded_chain",
     "ChaosCompressor", "ChaosCommunicator",
+    "TelemetryConfig", "TelemetryState", "TelemetryReader",
+    "JSONLSink", "TensorBoardSink", "MultiSink", "trace_stage",
     "TrainState", "init_train_state", "make_train_step", "make_eval_step",
     "data_parallel_mesh", "make_mesh",
     "__version__",

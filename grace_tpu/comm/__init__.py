@@ -27,6 +27,8 @@ from jax import lax
 
 from grace_tpu.core import (Communicator, Compressor, Ctx, Payload,
                             axis_size)
+from grace_tpu.telemetry.scopes import (STAGE_DECOMPRESS, STAGE_EXCHANGE,
+                                        trace_stage)
 
 __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
            "SignAllreduce", "TwoShotAllreduce"]
@@ -68,8 +70,10 @@ def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
             raise ValueError(
                 f"vote_dtype='bfloat16' is integer-exact only up to world "
                 f"size 256; this axis has {w} — use vote_dtype='float32'.")
-    dec = compressor.decompress(payload, ctx)
-    summed = _psum(dec.astype(vote_dtype), axis_name)
+    with trace_stage(STAGE_DECOMPRESS):
+        dec = compressor.decompress(payload, ctx)
+    with trace_stage(f"{STAGE_EXCHANGE}/psum_vote"):
+        summed = _psum(dec.astype(vote_dtype), axis_name)
     out = (summed >= 0).astype(vote_dtype) * 2 - 1
     return out.astype(dec.dtype)
 
@@ -104,7 +108,8 @@ class Allreduce(Communicator):
                 "differently, e.g. per-rank indices or norms). Use "
                 "Allgather/Broadcast instead — reference compatibility "
                 "matrix, IMPLEMENTING.md:43-45.")
-        summed = tuple(_psum(t, self.axis_name) for t in payload)
+        with trace_stage(f"{STAGE_EXCHANGE}/psum"):
+            summed = tuple(_psum(t, self.axis_name) for t in payload)
         if compressor.average and payload:
             if not all(jnp.issubdtype(t.dtype, jnp.inexact) for t in summed):
                 raise TypeError(
@@ -114,7 +119,8 @@ class Allreduce(Communicator):
                     "compatibility matrix in the reference).")
             w = self.world_size()
             summed = tuple(t / w for t in summed)
-        return compressor.decompress(summed, ctx)
+        with trace_stage(STAGE_DECOMPRESS):
+            return compressor.decompress(summed, ctx)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,20 +140,24 @@ class Allgather(Communicator):
                  ) -> jax.Array:
         if not payload:
             # e.g. PowerSGD: communication already happened inside compress.
-            return compressor.decompress(payload, ctx)
-        gathered = tuple(
-            lax.all_gather(t, self.axis_name, axis=0, tiled=False)
-            for t in payload)
-        fused = getattr(compressor, "fused_aggregate_decompress", None)
-        if fused is not None:
-            out = fused(gathered, ctx, axis_size(self.axis_name))
-            if out is not None:      # handles aggregate + average itself
-                return out
-        stacked = jax.vmap(lambda p: compressor.decompress(p, ctx))(gathered)
-        out = compressor.aggregate(stacked)
-        if compressor.average:
-            out = out / self.world_size()
-        return out
+            with trace_stage(STAGE_DECOMPRESS):
+                return compressor.decompress(payload, ctx)
+        with trace_stage(f"{STAGE_EXCHANGE}/all_gather"):
+            gathered = tuple(
+                lax.all_gather(t, self.axis_name, axis=0, tiled=False)
+                for t in payload)
+        with trace_stage(STAGE_DECOMPRESS):
+            fused = getattr(compressor, "fused_aggregate_decompress", None)
+            if fused is not None:
+                out = fused(gathered, ctx, axis_size(self.axis_name))
+                if out is not None:      # handles aggregate + average itself
+                    return out
+            stacked = jax.vmap(
+                lambda p: compressor.decompress(p, ctx))(gathered)
+            out = compressor.aggregate(stacked)
+            if compressor.average:
+                out = out / self.world_size()
+            return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -368,7 +378,8 @@ class TwoShotAllreduce(Communicator):
             _, _, arrays = _split_ctx(ctx)
             return tuple(payload), tuple(arrays)
 
-        payloads, ctx_arrays = jax.vmap(comp_one)(chunks, jnp.arange(w))
+        with trace_stage(f"{STAGE_EXCHANGE}/twoshot_stage1_compress"):
+            payloads, ctx_arrays = jax.vmap(comp_one)(chunks, jnp.arange(w))
 
         if self.stage2_feedback:
             from grace_tpu.memories import DgcMemory
@@ -382,7 +393,9 @@ class TwoShotAllreduce(Communicator):
 
         # Stage 2: swap chunk axis for world axis; aggregate the owned chunk.
         i = lax.axis_index(self.axis_name)
-        mine = tuple(lax.all_to_all(p, self.axis_name, 0, 0) for p in payloads)
+        with trace_stage(f"{STAGE_EXCHANGE}/twoshot_all_to_all"):
+            mine = tuple(lax.all_to_all(p, self.axis_name, 0, 0)
+                         for p in payloads)
         my_ctx = _join_ctx(treedef, static,
                            [jnp.take(a, i, axis=0) for a in ctx_arrays])
         stacked = jax.vmap(lambda p: compressor.decompress(p, my_ctx))(mine)
@@ -409,9 +422,13 @@ class TwoShotAllreduce(Communicator):
         mem_state = memory.update(compensated, payloads, view_ctx,
                                   _ChunkedView(compressor), mem_state)
 
-        gathered = tuple(lax.all_gather(p, self.axis_name, axis=0, tiled=False)
-                         for p in payload2)
-        out = jax.vmap(lambda p: compressor.decompress(p, ctx2))(gathered)
+        with trace_stage(f"{STAGE_EXCHANGE}/twoshot_all_gather"):
+            gathered = tuple(
+                lax.all_gather(p, self.axis_name, axis=0, tiled=False)
+                for p in payload2)
+        with trace_stage(STAGE_DECOMPRESS):
+            out = jax.vmap(
+                lambda p: compressor.decompress(p, ctx2))(gathered)
         out = out.reshape(-1)[:n].reshape(shape).astype(dtype)
         return out, mem_state, comp_state
 

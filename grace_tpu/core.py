@@ -37,6 +37,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from grace_tpu.telemetry.scopes import (STAGE_COMPENSATE, STAGE_COMPRESS,
+                                        STAGE_EXCHANGE, STAGE_MEMORY_UPDATE,
+                                        trace_stage)
+
 # A tuple of arrays that travels on the wire (may differ across ranks).
 Payload = Tuple[jax.Array, ...]
 # Decode context, identical across ranks (static python data or replicated arrays).
@@ -187,14 +191,25 @@ class Communicator:
         coeffs = getattr(memory, "linear_feedback_coeffs", None)
         fused = getattr(compressor, "fused_feedback_compress", None)
         if coeffs is not None and fused is not None and mem_state is not None:
-            fused_out = fused(x, mem_state, coeffs, rng,
-                              world=lambda: axis_size(self.axis_name))
+            with trace_stage(STAGE_COMPRESS):
+                fused_out = fused(x, mem_state, coeffs, rng,
+                                  world=lambda: axis_size(self.axis_name))
             if fused_out is not None:
                 payload, ctx, mem_state = fused_out
-                out = self.exchange(payload, ctx, compressor)
+                with trace_stage(STAGE_EXCHANGE):
+                    out = self.exchange(payload, ctx, compressor)
                 return out, mem_state, comp_state
-        compensated, mem_state = memory.compensate(x, mem_state)
-        payload, ctx, comp_state = compressor.compress(compensated, comp_state, rng)
-        mem_state = memory.update(compensated, payload, ctx, compressor, mem_state)
-        out = self.exchange(payload, ctx, compressor)
+        # Named scopes make each stage attributable in a Perfetto/XProf
+        # device trace (see grace_tpu.telemetry.scopes) — otherwise the
+        # whole pipeline renders as anonymous XLA fusions.
+        with trace_stage(STAGE_COMPENSATE):
+            compensated, mem_state = memory.compensate(x, mem_state)
+        with trace_stage(STAGE_COMPRESS):
+            payload, ctx, comp_state = compressor.compress(
+                compensated, comp_state, rng)
+        with trace_stage(STAGE_MEMORY_UPDATE):
+            mem_state = memory.update(compensated, payload, ctx, compressor,
+                                      mem_state)
+        with trace_stage(STAGE_EXCHANGE):
+            out = self.exchange(payload, ctx, compressor)
         return out, mem_state, comp_state

@@ -40,11 +40,14 @@ class Grace:
                          # (see grace_transform)
     escape: Any = None   # None | dense Compressor: the resilience escape
                          # hatch (see grace_transform / resilience.guard)
+    telemetry: Any = None  # None | True | capacity | dict | TelemetryConfig:
+                           # in-graph telemetry ring (grace_tpu.telemetry)
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,
                                self.communicator, seed=seed,
-                               fusion=self.fusion, escape=self.escape)
+                               fusion=self.fusion, escape=self.escape,
+                               telemetry=self.telemetry)
 
 
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
@@ -167,4 +170,7 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
                  memory=_build_memory(params, axis),
                  communicator=_build_communicator(params, axis),
                  fusion=fusion,
-                 escape=escape)
+                 escape=escape,
+                 # True | ring capacity | {"capacity": ..,
+                 # "compression_error": ..} — see grace_transform(telemetry=)
+                 telemetry=params.get("telemetry"))

@@ -43,9 +43,22 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from grace_tpu.telemetry.state import TelemetryState
 from grace_tpu.transform import set_fallback_flag
 
 __all__ = ["GuardState", "guard_transform"]
+
+
+def _strip_telemetry(tree):
+    """Drop TelemetryState nodes: the ring is *observational* (it records
+    e.g. the norm of a poisoned gradient verbatim), so its contents must
+    never flip a step bad on their own — the pipeline values it mirrors
+    are already scanned directly. The ring still rolls back with the rest
+    of the inner state on a bad step, so poisoned rows never survive into
+    a flush."""
+    return jax.tree_util.tree_map(
+        lambda n: None if isinstance(n, TelemetryState) else n,
+        tree, is_leaf=lambda n: isinstance(n, TelemetryState))
 
 
 class GuardState(NamedTuple):
@@ -90,7 +103,8 @@ def guard_transform(inner: optax.GradientTransformation,
     its global norm exceeds ``max_norm`` (if set), or — with ``check_state``
     (default) — when any inexact leaf of the *new* inner state is
     non-finite (catches poison that a saturating codec, e.g. a sign vote,
-    swallowed on the wire but still wrote into a residual). Bad steps emit
+    swallowed on the wire but still wrote into a residual; telemetry rings
+    are excluded — see ``_strip_telemetry``). Bad steps emit
     zero updates and keep the previous inner state bitwise; healthy steps
     pass both through bitwise-unchanged, so an uninjected guarded run is
     bit-identical to the unguarded one.
@@ -127,7 +141,7 @@ def guard_transform(inner: optax.GradientTransformation,
         if max_norm is not None:
             bad = bad | (optax.global_norm(new_updates) > max_norm)
         if check_state:
-            bad = bad | _nonfinite(new_inner)
+            bad = bad | _nonfinite(_strip_telemetry(new_inner))
         if axis_name is not None:
             bad = lax.psum(bad.astype(jnp.int32), axis_name) > 0
 

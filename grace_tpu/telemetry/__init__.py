@@ -1,0 +1,41 @@
+"""In-graph telemetry: on-device metric rings, named trace stages, sinks.
+
+Three layers (see each module's docstring for the design rationale):
+
+* :mod:`~grace_tpu.telemetry.state` — the on-device
+  :class:`TelemetryState` ring buffer that ``grace_transform(telemetry=…)``
+  threads through the optimizer state, accumulating per-step scalars
+  (gradient/update norms, residual health, compression error, *effective*
+  wire bytes across the dense-fallback flip) with zero host syncs.
+* :mod:`~grace_tpu.telemetry.reader` — :class:`TelemetryReader`, the host
+  drain: one ``jax.device_get`` per N-step window, guard counters bundled
+  into the same transfer.
+* :mod:`~grace_tpu.telemetry.sinks` — structured outputs
+  (:class:`JSONLSink` with provenance headers, dependency-free
+  :class:`TensorBoardSink`, :class:`MultiSink`).
+
+Plus :func:`trace_stage` (:mod:`~grace_tpu.telemetry.scopes`), which names
+the compress / exchange / decompress / memory-update stages in XLA op
+metadata so ``utils.profiling.trace`` captures attributable Perfetto spans.
+
+IMPORT CONSTRAINT: modules in this package must not import
+``grace_tpu.core`` / ``transform`` / ``resilience`` at module level —
+``core.py`` imports :mod:`scopes`, so anything heavier would cycle. The
+reader's ``GuardState`` lookup is deliberately lazy.
+"""
+
+from grace_tpu.telemetry.reader import TelemetryReader
+from grace_tpu.telemetry.scopes import trace_stage
+from grace_tpu.telemetry.sinks import (JSONLSink, MultiSink, Sink,
+                                       TensorBoardSink)
+from grace_tpu.telemetry.state import (FIELDS, TelemetryConfig,
+                                       TelemetryState, telemetry_init,
+                                       telemetry_record)
+
+__all__ = [
+    "FIELDS", "TelemetryConfig", "TelemetryState", "telemetry_init",
+    "telemetry_record",
+    "TelemetryReader",
+    "Sink", "JSONLSink", "TensorBoardSink", "MultiSink",
+    "trace_stage",
+]

@@ -1,0 +1,163 @@
+"""Host-side telemetry drain: one device-to-host transfer per flush window.
+
+``TelemetryReader`` is the bridge between the in-graph ring buffer
+(:class:`~grace_tpu.telemetry.state.TelemetryState`, written on-device every
+step) and the host world of sinks. The contract that keeps telemetry off the
+hot path: the training loop calls :meth:`TelemetryReader.update` every step,
+but only every ``every``-th call flushes — and a flush is exactly **one**
+``jax.device_get`` of the bundled rings, step ids, and guard counters
+(pinned by ``tests/test_telemetry.py::test_flush_is_one_transfer_per_window``).
+Between flushes the loop never blocks on telemetry.
+
+Semantics worth knowing:
+
+* Ring rows are keyed by the GraceState step counter, which only advances on
+  steps the guard *accepted* — a skipped (rolled-back) step leaves no row.
+  The guard's own counters (total skips, fallback window) are fetched in the
+  same transfer and stamped onto the last record of each flush as
+  ``guard_*`` fields, so bad steps remain observable.
+* If more than ``capacity`` accepted steps elapse between flushes, the
+  oldest rows are overwritten on-device. The reader detects the gap, counts
+  it in :attr:`dropped`, and stamps ``dropped_steps`` on the flush — silent
+  truncation would read as "covered everything".
+* Works on either state layout: the global view (telemetry leaves carrying a
+  leading world axis, as the train loop holds it) or the per-device view.
+  Cross-rank aggregation follows each field's spec in
+  :data:`~grace_tpu.telemetry.state.FIELDS`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+from grace_tpu.telemetry.state import FIELDS, TelemetryState
+
+__all__ = ["TelemetryReader"]
+
+_GUARD_FIELDS = ("notfinite_count", "last_bad_step", "consecutive",
+                 "fallback_remaining", "step")
+
+
+def _collect(tree, is_node) -> list:
+    found: list = []
+
+    def walk(node):
+        if is_node(node):
+            found.append(node)
+        return node
+
+    jax.tree_util.tree_map(walk, tree, is_leaf=is_node)
+    return found
+
+
+def _aggregate(values: np.ndarray, agg: str) -> float:
+    if agg == "max":
+        return float(values.max())
+    if agg == "first":
+        return float(values[0])
+    return float(values.mean())
+
+
+class TelemetryReader:
+    """Flush the on-device telemetry ring through a sink every N steps.
+
+    Usage::
+
+        reader = TelemetryReader(JSONLSink("run.jsonl",
+                                           provenance=run_provenance("synthetic")),
+                                 every=20)
+        for i, batch in enumerate(batches):
+            state, loss = step(state, batch)
+            reader.update(i, state)
+        reader.flush(state)      # drain the tail
+        reader.close()
+    """
+
+    def __init__(self, sink: Optional[Any] = None, every: int = 10):
+        if every < 1:
+            raise ValueError(f"flush interval must be >= 1; got {every}")
+        self.sink = sink
+        self.every = every
+        self.dropped = 0         # total steps lost to ring wraparound
+        self.flushes = 0         # completed device-to-host transfers
+        self._last_step = -1     # newest step id already emitted
+
+    def update(self, step: int, state) -> List[dict]:
+        """Per-loop-iteration hook: flushes on every ``every``-th call."""
+        if (step + 1) % self.every == 0:
+            return self.flush(state)
+        return []
+
+    def flush(self, state) -> List[dict]:
+        """Drain all unseen ring rows in ONE device-to-host transfer."""
+        telems = _collect(state, lambda n: isinstance(n, TelemetryState))
+        if not telems:
+            return []
+        from grace_tpu.resilience.guard import GuardState
+        guards = _collect(state, lambda n: isinstance(n, GuardState))
+
+        bundle: list = []
+        for t in telems:
+            bundle.append(t.rings)
+            bundle.append(t.steps)
+        guard_vals = None
+        if guards:
+            bundle.extend(getattr(guards[0], f) for f in _GUARD_FIELDS)
+        host = jax.device_get(bundle)          # the single transfer
+        self.flushes += 1
+        if guards:
+            guard_vals = {f"guard_{name}": int(v) for name, v in
+                          zip(_GUARD_FIELDS, host[len(host) - len(_GUARD_FIELDS):])}
+            host = host[:len(host) - len(_GUARD_FIELDS)]
+
+        records: List[dict] = []
+        newest = self._last_step
+        n_fields = len(FIELDS)
+        for ti in range(len(telems)):
+            rings = np.asarray(host[2 * ti])
+            steps = np.asarray(host[2 * ti + 1])
+            if rings.shape[-1] != n_fields or rings.ndim < 2:
+                raise ValueError(
+                    f"telemetry ring has shape {rings.shape}; expected "
+                    f"(..., capacity, {n_fields}) — state layout mismatch")
+            # Normalize to (world, capacity, n_fields): the global layout
+            # carries a leading world axis; per-device state does not.
+            rings = rings.reshape((-1,) + rings.shape[-2:])
+            steps = steps.reshape(-1, rings.shape[1])[0]   # replicated
+
+            fresh = np.flatnonzero(steps > self._last_step)
+            for slot in fresh[np.argsort(steps[fresh])]:
+                rec = {"step": int(steps[slot])}
+                if len(telems) > 1:
+                    rec["telemetry_index"] = ti
+                for fi, (name, agg) in enumerate(FIELDS):
+                    rec[name] = _aggregate(rings[:, slot, fi], agg)
+                records.append(rec)
+                newest = max(newest, int(steps[slot]))
+
+        if records:
+            expected = newest - self._last_step
+            seen = len({r["step"] for r in records})
+            gap = max(0, expected - seen)
+            if gap:
+                self.dropped += gap
+                records[-1]["dropped_steps"] = gap
+            if guard_vals:
+                records[-1].update(guard_vals)
+            self._last_step = newest
+            if self.sink is not None:
+                for rec in records:
+                    self.sink.write(rec)
+        elif guard_vals and self.sink is not None:
+            # No fresh rows (e.g. every accepted step already flushed, or
+            # all steps in the window were skipped) — still surface guard
+            # movement so a pathological run is not silent.
+            self.sink.write({"event": "guard_only", **guard_vals})
+        return records
+
+    def close(self) -> None:
+        if self.sink is not None:
+            self.sink.close()

@@ -1,0 +1,117 @@
+"""On-device telemetry state: a bounded ring buffer of per-step scalars.
+
+The survey paper GRACE implements is a *measurement* paper, yet the
+reproduction's observability so far is static (`wire_report` via
+``eval_shape``) or print-based (``GuardMonitor``). Nothing sees what happens
+*inside* the jitted step — where the dense-fallback escape hatch silently
+changes the real bytes-on-wire and error-feedback residuals drift unobserved.
+EQuARX-style quantized-collective work (PAPERS.md) lives or dies by readable
+traces of the collective schedule; THC argues the compression-error signal is
+itself a first-class training metric. Both point the same way: telemetry must
+live in the graph, not around it.
+
+:class:`TelemetryState` is that in-graph accumulator. ``grace_transform``
+threads it through the optimizer state alongside the rest of ``GraceState``:
+every update writes one row of :data:`FIELDS` scalars into a fixed-capacity
+ring buffer, entirely on-device — zero host syncs on the hot path. A
+host-side :class:`~grace_tpu.telemetry.reader.TelemetryReader` drains the
+ring every N steps in a **single** device-to-host transfer.
+
+Layout notes:
+
+* The state is **per-rank data** (like GraceState ``mem``/``comp``): in the
+  global view each leaf carries a leading world axis sharded over the mesh
+  axis, so recording needs no collectives of its own — each rank accumulates
+  its local scalars and the host aggregates at flush time per the field's
+  ``agg`` spec (post-exchange metrics such as ``update_norm`` are
+  rank-identical anyway; pre-exchange ones such as ``grad_norm`` genuinely
+  differ and the host reports their cross-rank mean).
+* Rows are keyed by the GraceState step counter; a slot holding step ``-1``
+  has never been written. Under :func:`~grace_tpu.resilience.guard_transform`
+  a skipped step rolls the whole ring back with the rest of the inner state,
+  so poisoned rows never survive into a flush — the guard's own counters
+  (which do record skips) ride along in the reader's flush bundle.
+* Everything is float32. Byte counts above 2**24 lose integer exactness
+  (~1e-7 relative) — fine for a telemetry stream; the analytic exact numbers
+  remain available from :func:`grace_tpu.utils.metrics.wire_report`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FIELDS", "FIELD_INDEX", "TelemetryConfig", "TelemetryState",
+           "telemetry_init", "telemetry_record"]
+
+# (name, host-side cross-rank aggregation) in ring-column order. "first"
+# marks values identical on every rank (static per branch, or derived from
+# replicated inputs); "mean"/"max" aggregate genuinely per-rank scalars.
+FIELDS = (
+    ("grad_norm", "mean"),          # ‖local grad‖ over all leaves, pre-exchange
+    ("update_norm", "mean"),        # ‖aggregated update‖ (rank-identical)
+    ("residual_norm", "mean"),      # ‖error-feedback memory state‖ per rank
+    ("residual_max", "max"),        # max |residual| — EF health / drift alarm
+    ("compression_error", "mean"),  # ‖g − decompress(compress(g))‖ / ‖g‖
+    ("wire_bytes", "first"),        # EFFECTIVE payload bytes this step
+    ("dense_bytes", "first"),       # dense cost of the same gradients
+    ("fallback", "max"),            # 1.0 while the dense escape hatch is live
+)
+
+FIELD_INDEX = {name: i for i, (name, _) in enumerate(FIELDS)}
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Static telemetry knobs (hashable — safe inside jit closures).
+
+    ``capacity`` bounds the on-device ring: it must be at least the reader's
+    flush interval or the oldest rows of a window are overwritten before the
+    flush reads them (the reader detects and counts such drops rather than
+    failing). ``compression_error`` gates the one genuinely non-free metric:
+    it re-runs compress→decompress on the step's gradients, which XLA CSEs
+    away only when the pipeline input is identical (no error-feedback
+    memory); with a residual memory it costs roughly one extra compress per
+    step. Disable it to make telemetry near-free.
+    """
+
+    capacity: int = 128
+    compression_error: bool = True
+
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise ValueError(f"telemetry capacity must be >= 1; "
+                             f"got {self.capacity}")
+
+
+class TelemetryState(NamedTuple):
+    rings: jax.Array   # (capacity, len(FIELDS)) float32 metric rows
+    steps: jax.Array   # (capacity,) int32 step id per row; -1 = never written
+
+
+def telemetry_init(config: TelemetryConfig) -> TelemetryState:
+    return TelemetryState(
+        rings=jnp.zeros((config.capacity, len(FIELDS)), jnp.float32),
+        steps=jnp.full((config.capacity,), -1, jnp.int32))
+
+
+def telemetry_record(telem: TelemetryState, count: jax.Array,
+                     values: Mapping[str, jax.Array]) -> TelemetryState:
+    """Write one row of scalars at slot ``count % capacity`` (in-graph).
+
+    ``values`` must provide every :data:`FIELDS` name; all are cast to
+    float32. Pure function of (state, count, values) — safe under jit,
+    shard_map, and the guard's where-select rollback.
+    """
+    missing = [name for name, _ in FIELDS if name not in values]
+    if missing:
+        raise KeyError(f"telemetry_record missing fields {missing}")
+    row = jnp.stack([jnp.asarray(values[name], jnp.float32).reshape(())
+                     for name, _ in FIELDS])
+    idx = jnp.mod(count, telem.steps.shape[0]).astype(jnp.int32)
+    return TelemetryState(rings=telem.rings.at[idx].set(row),
+                          steps=telem.steps.at[idx].set(
+                              jnp.asarray(count, jnp.int32)))

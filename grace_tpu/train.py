@@ -39,6 +39,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from grace_tpu.core import DEFAULT_AXIS
 from grace_tpu.parallel import replicated, shard_map
+from grace_tpu.telemetry.scopes import (STAGE_APPLY, STAGE_FWD_BWD,
+                                        STAGE_OPTIMIZER, trace_stage)
 from grace_tpu.transform import (add_world_axis, partition_specs,
                                  strip_world_axis)
 
@@ -111,9 +113,16 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
 
     def device_step(state: TrainState, batch):
         opt_state = strip_world_axis(state.opt_state)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        # Stage scopes name the phases in an XLA device trace (see
+        # grace_tpu.telemetry.scopes); the grace transform inside
+        # optimizer.update adds its own compress/exchange/decompress spans.
+        with trace_stage(STAGE_FWD_BWD):
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        with trace_stage(STAGE_OPTIMIZER):
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  state.params)
+        with trace_stage(STAGE_APPLY):
+            params = optax.apply_updates(state.params, updates)
         loss = lax.pmean(loss, axis_name)
         return TrainState(params, add_world_axis(opt_state)), loss
 
@@ -142,13 +151,18 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
 
     def device_step(state: StatefulTrainState, batch):
         opt_state = strip_world_axis(state.opt_state)
-        (loss, mstate), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.model_state, batch)
+        with trace_stage(STAGE_FWD_BWD):
+            (loss, mstate), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(
+                state.params, state.model_state, batch)
         if sync_model_state:
             mstate = jax.tree_util.tree_map(
                 lambda m: lax.pmean(m, axis_name), mstate)
-        updates, opt_state = optimizer.update(grads, opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        with trace_stage(STAGE_OPTIMIZER):
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  state.params)
+        with trace_stage(STAGE_APPLY):
+            params = optax.apply_updates(state.params, updates)
         loss = lax.pmean(loss, axis_name)
         return (StatefulTrainState(params, mstate, add_world_axis(opt_state)),
                 loss)

@@ -16,10 +16,12 @@ parameter's compensate→compress→update→exchange is traced into ONE XLA
 program — the reference's per-parameter Python loop over world_size × n_params
 decompressions (SURVEY.md §3.1 hot loop) disappears into the compiler.
 
-State layout: ``GraceState(count, rng_key, mem, comp, fallback)`` where
-``mem``/``comp`` are tuples aligned with the flattened gradient leaves and
+State layout: ``GraceState(count, rng_key, mem, comp, fallback, telem)``
+where ``mem``/``comp`` are tuples aligned with the flattened gradient leaves,
 ``fallback`` is the replicated resilience health flag (see
-``grace_transform(escape=...)``). The rng key is
+``grace_transform(escape=...)``), and ``telem`` is the optional on-device
+telemetry ring (``grace_transform(telemetry=...)``; None when telemetry is
+off, so the default state is unchanged). The rng key is
 replicated across ranks, so per-(step, leaf) keys derived via ``fold_in`` are
 rank-identical — the explicit contract RandomK/PowerSGD rely on (the
 reference relied on global-seed side effects, grace_dl/dist/compressor/
@@ -49,6 +51,9 @@ import optax
 from jax import lax
 
 from grace_tpu.core import Communicator, Compressor, Memory, State
+from grace_tpu.telemetry.scopes import STAGE_TELEMETRY, trace_stage
+from grace_tpu.telemetry.state import (TelemetryConfig, telemetry_init,
+                                       telemetry_record)
 
 
 class GraceState(NamedTuple):
@@ -61,6 +66,11 @@ class GraceState(NamedTuple):
     # Written by resilience.guard_transform via set_fallback_flag; plain
     # grace_transform never sets it, so the default False is a no-op.
     fallback: jax.Array = False
+    # On-device telemetry ring (per-rank data, like mem/comp): a
+    # grace_tpu.telemetry.TelemetryState when grace_transform was built with
+    # telemetry=..., else None (an empty pytree node — invisible to
+    # checkpointing, sharding, and the guard).
+    telem: Any = None
 
 
 def _is_grace(x) -> bool:
@@ -68,13 +78,14 @@ def _is_grace(x) -> bool:
 
 
 def _map_grace_varying(fn, tree):
-    """Apply ``fn`` to the device-varying leaves (mem/comp) of every
+    """Apply ``fn`` to the device-varying leaves (mem/comp/telem) of every
     GraceState embedded in ``tree``; leave all other leaves untouched."""
 
     def per_node(node):
         if _is_grace(node):
             return node._replace(mem=jax.tree_util.tree_map(fn, node.mem),
-                                 comp=jax.tree_util.tree_map(fn, node.comp))
+                                 comp=jax.tree_util.tree_map(fn, node.comp),
+                                 telem=jax.tree_util.tree_map(fn, node.telem))
         return node
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -112,11 +123,15 @@ def partition_specs(tree, axis_name: str):
     def per_node(node):
         if _is_grace(node):
             return GraceState(
-                jax.tree_util.tree_map(lambda _: P(), node.count),
-                jax.tree_util.tree_map(lambda _: P(), node.rng_key),
-                jax.tree_util.tree_map(lambda _: P(axis_name), node.mem),
-                jax.tree_util.tree_map(lambda _: P(axis_name), node.comp),
-                jax.tree_util.tree_map(lambda _: P(), node.fallback))
+                count=jax.tree_util.tree_map(lambda _: P(), node.count),
+                rng_key=jax.tree_util.tree_map(lambda _: P(), node.rng_key),
+                mem=jax.tree_util.tree_map(lambda _: P(axis_name), node.mem),
+                comp=jax.tree_util.tree_map(lambda _: P(axis_name),
+                                            node.comp),
+                fallback=jax.tree_util.tree_map(lambda _: P(),
+                                                node.fallback),
+                telem=jax.tree_util.tree_map(lambda _: P(axis_name),
+                                             node.telem))
         return jax.tree_util.tree_map(lambda _: P(), node)
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -174,10 +189,29 @@ def _bucketize(shapes_dtypes, bucket_bytes: Optional[int]):
     return buckets, cdtype
 
 
+def _normalize_telemetry(telemetry) -> Optional[TelemetryConfig]:
+    """Accept the ergonomic spellings of the telemetry knob: None/False
+    (off), True (defaults), int (ring capacity), dict (config kwargs), or a
+    TelemetryConfig."""
+    if telemetry is None or telemetry is False:
+        return None
+    if telemetry is True:
+        return TelemetryConfig()
+    if isinstance(telemetry, TelemetryConfig):
+        return telemetry
+    if isinstance(telemetry, int):
+        return TelemetryConfig(capacity=telemetry)
+    if isinstance(telemetry, dict):
+        return TelemetryConfig(**telemetry)
+    raise TypeError(f"telemetry must be None/bool/int/dict/TelemetryConfig; "
+                    f"got {type(telemetry).__name__}")
+
+
 def grace_transform(compressor: Compressor, memory: Memory,
                     communicator: Communicator, seed: int = 0,
                     fusion: Optional[int | str] = None,
-                    escape: Optional[Compressor] = None
+                    escape: Optional[Compressor] = None,
+                    telemetry=None
                     ) -> optax.GradientTransformation:
     """Build the compressed-exchange transformation.
 
@@ -230,7 +264,22 @@ def grace_transform(compressor: Compressor, memory: Memory,
     off when the flag clears. The flag is driven by
     :func:`grace_tpu.resilience.guard_transform`; without a guard it stays
     False and the cond always takes the compressed branch.
+
+    ``telemetry`` (None | True | int capacity | dict | ``TelemetryConfig``):
+    arm the in-graph telemetry ring (:mod:`grace_tpu.telemetry`). Every
+    update then records per-step scalars — gradient/update norms,
+    residual-memory norm and max (error-feedback health), the relative
+    compression error ``‖g − decompress(compress(g))‖/‖g‖``, and the
+    *effective* wire bytes, which flip to the ``escape`` codec's dense cost
+    while the fallback flag is set — into a bounded on-device ring buffer
+    (``GraceState.telem``) with zero host syncs; drain it with
+    :class:`grace_tpu.telemetry.TelemetryReader`. The compression-error
+    metric re-runs compress→decompress on the step's gradients (XLA CSEs
+    the duplicate when no error-feedback memory rewrites the input); set
+    ``TelemetryConfig(compression_error=False)`` to make telemetry
+    near-free.
     """
+    telemetry = _normalize_telemetry(telemetry)
     if escape is not None and not (getattr(escape, "summable_payload", False)
                                    and escape.average):
         raise ValueError(
@@ -280,7 +329,9 @@ def grace_transform(compressor: Compressor, memory: Memory,
         return GraceState(count=jnp.zeros((), jnp.int32),
                           rng_key=jax.random.key_data(jax.random.key(seed)),
                           mem=mem, comp=comp,
-                          fallback=jnp.zeros((), jnp.bool_))
+                          fallback=jnp.zeros((), jnp.bool_),
+                          telem=(telemetry_init(telemetry)
+                                 if telemetry is not None else None))
 
     def _run_compressed(operand):
         leaves, mem, comp, step_key = operand
@@ -353,16 +404,148 @@ def grace_transform(compressor: Compressor, memory: Memory,
         gradients; mem/comp pass through untouched so error feedback resumes
         exactly where it paused when compression re-arms."""
         from grace_tpu.comm import Allreduce
+        from grace_tpu.telemetry.scopes import STAGE_DENSE_ESCAPE
 
         leaves, mem, comp, step_key = operand
         allreduce = Allreduce(axis_name=communicator.axis_name)
         outs = []
-        for i, g in enumerate(leaves):
-            rng = jax.random.fold_in(step_key, i)
-            payload, ctx, _ = escape.compress(g, escape.init_state(g), rng)
-            out = allreduce.exchange(payload, ctx, escape)
-            outs.append(out.astype(jnp.result_type(g)))
+        with trace_stage(STAGE_DENSE_ESCAPE):
+            for i, g in enumerate(leaves):
+                rng = jax.random.fold_in(step_key, i)
+                payload, ctx, _ = escape.compress(g, escape.init_state(g),
+                                                  rng)
+                out = allreduce.exchange(payload, ctx, escape)
+                outs.append(out.astype(jnp.result_type(g)))
         return tuple(outs), mem, comp
+
+    # -- telemetry ----------------------------------------------------------
+
+    _wire_plan_cache: dict = {}
+
+    def _wire_plan(leaves):
+        """(dense, compressed, escape) logical payload bytes for these
+        leaves under the active fusion mode. Static Python ints, cached per
+        leaf signature — eval_shape tracing inside ``payload_nbytes`` is a
+        trace-time cost paid once per (shape, dtype) set, never at run
+        time. Same logical-vs-padded-bytes caveat as
+        :func:`grace_tpu.utils.metrics.wire_report`."""
+        from grace_tpu.utils.metrics import payload_nbytes
+
+        sig = tuple((tuple(jnp.shape(l)), str(jnp.result_type(l)))
+                    for l in leaves)
+        plan = _wire_plan_cache.get(sig)
+        if plan is not None:
+            return plan
+        structs = [jax.ShapeDtypeStruct(shape, jnp.dtype(d))
+                   for shape, d in sig]
+        dense = sum(int(np.prod(s.shape, dtype=np.int64)) * s.dtype.itemsize
+                    for s in structs)
+        if grouped:
+            comp_b = sum(payload_nbytes(compressor, structs[idxs[0]])
+                         * len(idxs) for idxs in _group_views(leaves))
+        elif fused:
+            buckets, cdtype = _bucket_views(leaves)
+            comp_b = sum(
+                payload_nbytes(compressor, jax.ShapeDtypeStruct(
+                    (sum(int(np.prod(structs[i].shape, dtype=np.int64))
+                         for i in idxs),), jnp.dtype(cdtype)))
+                for idxs in buckets)
+        else:
+            comp_b = sum(payload_nbytes(compressor, s) for s in structs)
+        esc_b = (sum(payload_nbytes(escape, s) for s in structs)
+                 if escape is not None else None)
+        plan = _wire_plan_cache[sig] = (dense, comp_b, esc_b)
+        return plan
+
+    def _sqsum(ls) -> jax.Array:
+        tot = jnp.zeros((), jnp.float32)
+        for l in ls:
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact):
+                tot = tot + jnp.sum(jnp.square(l.astype(jnp.float32)))
+        return tot
+
+    def _codec_error_sq(leaves, comp, step_key) -> jax.Array:
+        """Σ‖x − decompress(compress(x))‖² over the exact structures (and
+        rng derivation) the active fusion mode compresses — so with no
+        error-feedback memory the duplicate compress CSEs against the
+        pipeline's own."""
+        diff = jnp.zeros((), jnp.float32)
+        if grouped:
+            for gi, idxs in enumerate(_group_views(leaves)):
+                stacked = jnp.stack([leaves[i] for i in idxs])
+                keys = jax.random.split(
+                    jax.random.fold_in(step_key, gi), len(idxs))
+
+                def roundtrip(g, cs, key):
+                    payload, ctx, _ = compressor.compress(g, cs, key)
+                    return compressor.decompress(payload, ctx)
+
+                dec = jax.vmap(roundtrip)(stacked, comp[gi], keys)
+                diff = diff + _sqsum([stacked - dec])
+        elif fused:
+            buckets, cdtype = _bucket_views(leaves)
+            for b, idxs in enumerate(buckets):
+                flat = jnp.concatenate([jnp.ravel(leaves[i]).astype(cdtype)
+                                        for i in idxs])
+                payload, ctx, _ = compressor.compress(
+                    flat, comp[b], jax.random.fold_in(step_key, b))
+                diff = diff + _sqsum([flat
+                                      - compressor.decompress(payload, ctx)])
+        else:
+            for i, g in enumerate(leaves):
+                payload, ctx, _ = compressor.compress(
+                    g, comp[i], jax.random.fold_in(step_key, i))
+                diff = diff + _sqsum([g - compressor.decompress(payload,
+                                                                ctx)])
+        return diff
+
+    def _telemetry_next(state: GraceState, leaves, outs, new_mem, step_key):
+        """One telemetry row, written at slot count % capacity. Pure
+        in-graph math over values the step already computed (plus the
+        optional codec round-trip) — no collectives, no host syncs."""
+        if state.telem is None:
+            raise ValueError(
+                "grace_transform was built with telemetry=... but the state "
+                "has no telemetry ring — it was initialized by a transform "
+                "without telemetry (or restored from such a checkpoint). "
+                "Re-init the optimizer state with the telemetry-enabled "
+                "transform.")
+        dense_b, comp_b, esc_b = _wire_plan(leaves)
+        grad_norm = jnp.sqrt(_sqsum(leaves))
+        update_norm = jnp.sqrt(_sqsum(outs))
+        mem_leaves = [l for l in jax.tree_util.tree_leaves(new_mem)
+                      if hasattr(l, "dtype")
+                      and jnp.issubdtype(l.dtype, jnp.inexact)]
+        residual_norm = jnp.sqrt(_sqsum(mem_leaves))
+        residual_max = (jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(l.astype(jnp.float32))) for l in mem_leaves]))
+            if mem_leaves else jnp.zeros((), jnp.float32))
+        if telemetry.compression_error:
+            err = jnp.sqrt(_codec_error_sq(leaves, state.comp, step_key)) \
+                / jnp.maximum(grad_norm, jnp.asarray(1e-20, jnp.float32))
+            if escape is not None:
+                # During a dense window the codec is bypassed: the
+                # *effective* error of what actually shipped is ~0.
+                err = jnp.where(jnp.asarray(state.fallback, jnp.bool_),
+                                jnp.zeros((), jnp.float32), err)
+        else:
+            err = jnp.zeros((), jnp.float32)
+        if escape is None:
+            eff = jnp.asarray(float(comp_b), jnp.float32)
+        else:
+            eff = jnp.where(jnp.asarray(state.fallback, jnp.bool_),
+                            jnp.asarray(float(esc_b), jnp.float32),
+                            jnp.asarray(float(comp_b), jnp.float32))
+        return telemetry_record(state.telem, state.count, {
+            "grad_norm": grad_norm,
+            "update_norm": update_norm,
+            "residual_norm": residual_norm,
+            "residual_max": residual_max,
+            "compression_error": err,
+            "wire_bytes": eff,
+            "dense_bytes": jnp.asarray(float(dense_b), jnp.float32),
+            "fallback": jnp.asarray(state.fallback, jnp.float32),
+        })
 
     def update(updates, state: GraceState, params=None):
         del params
@@ -380,9 +563,14 @@ def grace_transform(compressor: Compressor, memory: Memory,
             outs, new_mem, new_comp = lax.cond(
                 jnp.asarray(state.fallback, jnp.bool_),
                 _run_dense, _run_compressed, operand)
+        telem = state.telem
+        if telemetry is not None:
+            with trace_stage(STAGE_TELEMETRY):
+                telem = _telemetry_next(state, leaves, outs, new_mem,
+                                        step_key)
         new_state = GraceState(count=state.count + 1, rng_key=state.rng_key,
                                mem=new_mem, comp=new_comp,
-                               fallback=state.fallback)
+                               fallback=state.fallback, telem=telem)
         return jax.tree_util.tree_unflatten(treedef, outs), new_state
 
     return optax.GradientTransformation(init, update)

@@ -11,16 +11,17 @@ per-algorithm bytes-on-wire / compression-ratio accounting (`wire_report`).
 """
 
 from grace_tpu.utils.logging import (GuardMonitor, TableLogger, Timer,
-                                     TSVLogger, localtime, rank_zero_only,
-                                     rank_zero_print, run_provenance)
+                                     TSVLogger, git_commit, localtime,
+                                     rank_zero_only, rank_zero_print,
+                                     run_provenance)
 from grace_tpu.utils.metrics import (CompressionReport, LeafReport,
                                      debug_nan_residuals, guard_report,
                                      payload_nbytes, wire_report)
 from grace_tpu.utils.profiling import StepTimer, trace
 
 __all__ = [
-    "GuardMonitor", "TableLogger", "TSVLogger", "Timer", "localtime",
-    "rank_zero_only", "rank_zero_print", "run_provenance",
+    "GuardMonitor", "TableLogger", "TSVLogger", "Timer", "git_commit",
+    "localtime", "rank_zero_only", "rank_zero_print", "run_provenance",
     "CompressionReport", "LeafReport", "debug_nan_residuals",
     "guard_report", "payload_nbytes", "wire_report",
     "StepTimer", "trace",

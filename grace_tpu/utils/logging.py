@@ -24,7 +24,8 @@ from typing import Callable, Mapping, Optional, Sequence, TextIO
 import jax
 
 __all__ = ["Timer", "TableLogger", "TSVLogger", "GuardMonitor", "localtime",
-           "rank_zero_only", "rank_zero_print", "run_provenance"]
+           "rank_zero_only", "rank_zero_print", "run_provenance",
+           "git_commit"]
 
 
 def localtime() -> str:
@@ -75,12 +76,21 @@ class Timer:
 
 
 class TableLogger:
-    """Aligned-column stdout logger; header latched from the first row's keys."""
+    """Aligned-column stdout logger; header latched from the first row's keys.
+
+    Later rows may gain or lose keys without breaking the table — exactly
+    what happens when telemetry fields appear only after the first flush
+    window (warmup rows have no ``grad_norm`` yet). A missing key renders as
+    a blank cell; a key the header never saw is skipped, with a one-time
+    ``# new columns (ignored): …`` notice per key so the drift is visible
+    without re-flowing the table.
+    """
 
     def __init__(self, width: int = 12, stream: Optional[TextIO] = None):
         self.width = width
         self.stream = stream
         self._keys: Optional[Sequence[str]] = None
+        self._announced: set = set()
 
     def _emit(self, line: str) -> None:
         print(line, file=self.stream)
@@ -89,8 +99,16 @@ class TableLogger:
         if self._keys is None:
             self._keys = list(row.keys())
             self._emit(" ".join(f"{k:>{self.width}s}" for k in self._keys))
+        new = [k for k in row if k not in self._keys
+               and k not in self._announced]
+        if new:
+            self._announced.update(new)
+            self._emit(f"# new columns (ignored): {', '.join(new)}")
         cells = []
         for k in self._keys:
+            if k not in row:
+                cells.append(" " * self.width)
+                continue
             v = row[k]
             if isinstance(v, float):
                 cells.append(f"{v:{self.width}.4f}")
@@ -144,11 +162,27 @@ class GuardMonitor:
         for i, batch in enumerate(batches):
             state, loss = step(state, batch)
             mon.update(i, guard_report(state))
+
+    ``sink`` (any :class:`grace_tpu.telemetry.Sink`) additionally emits
+    each transition as a structured record — ``{"event": "guard_skip" |
+    "guard_fallback_engaged" | "guard_rearmed", "step": …, **report}`` —
+    into the same JSONL/TensorBoard stream the telemetry reader writes, so
+    guard edges line up against the per-step metric rows. Transition
+    edges are exact: re-arm fires on the first step whose report shows
+    ``fallback_active`` False after a True (pinned by
+    tests/test_telemetry.py::test_guard_monitor_transition_edges).
     """
 
-    def __init__(self, printer: Optional[Callable[..., None]] = None):
+    def __init__(self, printer: Optional[Callable[..., None]] = None,
+                 sink=None):
         self._print = printer or rank_zero_print
+        self._sink = sink
         self._last: Optional[dict] = None
+
+    def _event(self, name: str, step: int,
+               report: Mapping[str, object]) -> None:
+        if self._sink is not None:
+            self._sink.write({"event": name, "step": step, **report})
 
     def update(self, step: int, report: Mapping[str, object]) -> None:
         if not report:
@@ -160,27 +194,56 @@ class GuardMonitor:
             self._print(f"[guard] step {step}: non-finite/exploding update "
                         f"skipped (total={report['notfinite_count']}, "
                         f"consecutive={report['consecutive']})")
+            self._event("guard_skip", step, report)
         if report["fallback_active"] and not prev["fallback_active"]:
             self._print(f"[guard] step {step}: dense fallback engaged for "
                         f"{report['fallback_remaining']} steps")
+            self._event("guard_fallback_engaged", step, report)
         if prev["fallback_active"] and not report["fallback_active"]:
             self._print(f"[guard] step {step}: compression re-armed")
+            self._event("guard_rearmed", step, report)
+
+
+def git_commit() -> Optional[str]:
+    """Short git commit of the grace-tpu checkout, or None (best-effort).
+
+    Evidence files must be attributable to a revision (VERDICT discipline:
+    a number nobody can reproduce is not evidence). Resolved against the
+    package's own directory — the process cwd may be anywhere.
+    """
+    import os
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else None
+    except Exception:
+        return None
 
 
 def run_provenance(data: str, **extra: object) -> dict:
     """The standard provenance block for a training-curve evidence file.
 
     ``data`` names the data source honestly — ``"synthetic"`` or
-    ``"real:<path>"``. Platform/device/host and UTC timestamp are filled in
-    from the live environment; pass anything run-specific via ``extra``
+    ``"real:<path>"``. Platform/device/host, UTC timestamp, and the git
+    commit (best-effort, absent outside a checkout) are filled in from the
+    live environment; pass anything run-specific via ``extra``
     (e.g. ``argv=" ".join(sys.argv[1:])``).
     """
     dev = jax.devices()[0]
-    return {
+    prov = {
         "data": data,
         "platform": dev.platform,
         "device": getattr(dev, "device_kind", dev.platform),
         "n_devices": len(jax.devices()),
         "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        **extra,
     }
+    rev = git_commit()
+    if rev is not None:
+        prov["git_commit"] = rev
+    prov.update(extra)
+    return prov

@@ -145,18 +145,23 @@ def guard_report(state: Any) -> Dict[str, Any]:
             "fallback_active": fr > 0}
 
 
-def debug_nan_residuals(state: Any) -> Dict[str, int]:
-    """NaN census over every floating leaf of a state pytree.
+def debug_nan_residuals(state: Any) -> Dict[str, Dict[str, int]]:
+    """Non-finite (NaN **and** Inf) census over every floating leaf of a
+    state pytree.
 
     Debug aid for the fused-kernel NaN contract corner (IMPLEMENTING.md,
     "Fused local fast path"): under a NaN gradient the fused chunk-Top-K
     kernel keeps the NaN in the *residual* (re-injected by compensate each
     step) instead of shipping it on the wire like the staged path, so a
-    poisoned lane is invisible in the loss. Run this periodically over the
-    optimizer/GRACE state to surface it: returns ``{leaf_path: nan_count}``
-    for leaves with any NaN — empty dict means clean. All per-leaf counts
-    are fetched in ONE device-to-host transfer so a state with hundreds of
-    leaves does not serialize hundreds of blocking syncs.
+    poisoned lane is invisible in the loss. Infs matter just as much — an
+    overflow born inside codec arithmetic (e.g. a quantizer scale blowing
+    up) lands in the residual as ±Inf, not NaN, and poisons later steps
+    identically. Run this periodically over the optimizer/GRACE state to
+    surface both: returns ``{leaf_path: {"nan": n, "inf": m}}`` for leaves
+    with any non-finite value (``~jnp.isfinite``) — empty dict means clean.
+    All per-leaf counts are fetched in ONE device-to-host transfer so a
+    state with hundreds of leaves does not serialize hundreds of blocking
+    syncs.
     """
     flat, _ = jax.tree_util.tree_flatten_with_path(state)
     paths, counts = [], []
@@ -165,6 +170,8 @@ def debug_nan_residuals(state: Any) -> Dict[str, int]:
                 and jnp.issubdtype(leaf.dtype, jnp.floating)):
             continue
         paths.append(jax.tree_util.keystr(path))
-        counts.append(jnp.isnan(leaf).sum())
+        counts.append(jnp.stack([jnp.isnan(leaf).sum(),
+                                 jnp.isinf(leaf).sum()]))
     counts = jax.device_get(counts)
-    return {p: int(c) for p, c in zip(paths, counts) if int(c)}
+    return {p: {"nan": int(c[0]), "inf": int(c[1])}
+            for p, c in zip(paths, counts) if int(c[0]) or int(c[1])}

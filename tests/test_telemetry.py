@@ -1,0 +1,430 @@
+"""In-graph telemetry: ring buffer, reader, sinks, guard interplay.
+
+The properties pinned here are the acceptance criteria of the telemetry
+subsystem (ISSUE 2): per-step metric rows recorded entirely on-device and
+drained in ONE device-to-host transfer per flush window, ring wraparound
+accounted (never silent), telemetry-under-guard (a skipped step's row rolls
+back with the state — accumulators never corrupt), and effective wire bytes
+flipping to the dense escape cost across a fallback window and returning
+after re-arm.
+"""
+
+import json
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from grace_tpu import grace_from_params
+from grace_tpu.resilience import guarded_chain
+from grace_tpu.telemetry import (FIELDS, JSONLSink, MultiSink,
+                                 TelemetryConfig, TelemetryReader,
+                                 TensorBoardSink)
+from grace_tpu.telemetry.sinks import masked_crc
+from grace_tpu.train import init_train_state, make_train_step
+from grace_tpu.transform import set_fallback_flag
+from grace_tpu.utils import payload_nbytes
+from grace_tpu.utils.logging import GuardMonitor, run_provenance
+from grace_tpu.utils.metrics import guard_report
+
+BATCH, DIM, CLASSES = 64, 20, 4
+
+TOPK_TELEM = {"compressor": "topk", "compress_ratio": 0.3,
+              "memory": "residual", "communicator": "allgather"}
+
+REQUIRED = ("grad_norm", "update_norm", "residual_norm", "residual_max",
+            "compression_error", "wire_bytes", "dense_bytes", "fallback")
+
+
+def _problem(seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(DIM, CLASSES)).astype(np.float32)
+    x = rng.normal(size=(BATCH * 8, DIM)).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    logits = x @ params["w"] + params["b"]
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _init_params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(
+                rng.normal(size=(DIM, CLASSES)).astype(np.float32) * 0.1),
+            "b": jnp.zeros((CLASSES,), jnp.float32)}
+
+
+def _build(mesh, grace_params, lr=0.3, guard=False, **guard_kw):
+    grc = grace_from_params(dict(grace_params))
+    if guard:
+        tx = guarded_chain(grc, optax.sgd(lr), **guard_kw)
+    else:
+        tx = optax.chain(grc.transform(seed=0), optax.sgd(lr))
+    state = init_train_state(_init_params(), tx, mesh)
+    step = make_train_step(_loss_fn, tx, mesh, donate=False)
+    return state, step
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(dict(record))
+
+    def close(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 50-step run -> JSONL with all fields + provenance header,
+# one transfer per window
+# ---------------------------------------------------------------------------
+
+@pytest.mark.telemetry
+def test_fifty_step_jsonl_with_provenance(mesh, tmp_path):
+    x, y = _problem()
+    params = dict(TOPK_TELEM, telemetry=True)
+    state, step = _build(mesh, params)
+
+    path = tmp_path / "run.jsonl"
+    sink = JSONLSink(path, provenance=run_provenance("synthetic",
+                                                     tool="test"))
+    reader = TelemetryReader(sink, every=10)
+    for i in range(50):
+        state, _ = step(state, (x, y))
+        reader.update(i, state)
+    reader.close()
+
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    header, records = lines[0], lines[1:]
+    assert "provenance" in header
+    assert header["provenance"]["data"] == "synthetic"
+    assert header["provenance"]["platform"] == "cpu"
+    assert len(records) == 50
+    assert [r["step"] for r in records] == list(range(50))
+    for rec in records:
+        for field in REQUIRED:
+            assert field in rec, field
+        assert np.isfinite(rec["grad_norm"]) and rec["grad_norm"] > 0
+        assert rec["residual_norm"] >= 0
+        assert 0 <= rec["compression_error"] <= 1.5
+        assert rec["wire_bytes"] < rec["dense_bytes"]
+    assert reader.flushes == 5 and reader.dropped == 0
+
+
+@pytest.mark.telemetry
+def test_flush_is_one_transfer_per_window(mesh, monkeypatch):
+    """The acceptance bound: each N-step window costs exactly one
+    jax.device_get, and the steps between flushes cost zero."""
+    x, y = _problem()
+    state, step = _build(mesh, dict(TOPK_TELEM, telemetry=True))
+    reader = TelemetryReader(sink=None, every=10)
+
+    calls = []
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    for i in range(50):
+        state, _ = step(state, (x, y))
+        reader.update(i, state)
+    assert len(calls) == 5
+    assert reader.flushes == 5
+
+
+# ---------------------------------------------------------------------------
+# ring wraparound + flush atomicity under jit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.telemetry
+def test_ring_wraparound_is_counted_not_silent(mesh):
+    """Flush interval (20) beyond ring capacity (8): only the newest 8 rows
+    survive, and the reader reports the 12 dropped — silent truncation
+    would read as full coverage."""
+    x, y = _problem()
+    state, step = _build(mesh, dict(TOPK_TELEM, telemetry=8))
+    reader = TelemetryReader(sink=None, every=20)
+    records = []
+    for i in range(20):
+        state, _ = step(state, (x, y))
+        records += reader.update(i, state)
+    assert [r["step"] for r in records] == list(range(12, 20))
+    assert records[-1]["dropped_steps"] == 12
+    assert reader.dropped == 12
+
+
+@pytest.mark.telemetry
+def test_flush_windows_are_contiguous_and_exact(mesh):
+    """Flush atomicity under jit: consecutive flushes partition the step
+    sequence — no duplicates, no gaps, rows bitwise-stable across the
+    flush boundary."""
+    x, y = _problem()
+    state, step = _build(mesh, dict(TOPK_TELEM,
+                                    telemetry=TelemetryConfig(capacity=32)))
+    reader = TelemetryReader(sink=None, every=7)
+    seen = []
+    for i in range(21):
+        state, _ = step(state, (x, y))
+        flushed = reader.update(i, state)
+        if flushed:
+            assert len(flushed) == 7
+        seen += flushed
+    assert [r["step"] for r in seen] == list(range(21))
+    # Re-flushing with no new steps emits nothing (idempotent drain).
+    assert reader.flush(state) == []
+
+
+# ---------------------------------------------------------------------------
+# telemetry under the guard
+# ---------------------------------------------------------------------------
+
+@pytest.mark.telemetry
+def test_skipped_step_does_not_corrupt_accumulators(mesh):
+    """A poisoned step rolls the ring back with the rest of the inner
+    state: no NaN row ever reaches a flush, the step counter does not
+    advance, and the guard's own counters arrive via the same flush."""
+    x, y = _problem()
+    params = dict(TOPK_TELEM, escape="fp16", telemetry=32)
+    state, step = _build(mesh, params, guard=True)
+
+    xbad = np.asarray(x).copy()
+    xbad[0, 0] = np.nan
+    batches = [x, x, x, jnp.asarray(xbad), x, x]
+    reader = TelemetryReader(sink=None, every=len(batches))
+    records = []
+    for i, xb in enumerate(batches):
+        state, _ = step(state, (jnp.asarray(xb), y))
+        records += reader.update(i, state)
+
+    # 6 wall steps, 1 skipped -> 5 accepted rows, counts 0..4 contiguous.
+    assert [r["step"] for r in records] == list(range(5))
+    for rec in records:
+        for field in REQUIRED:
+            assert np.isfinite(rec[field]), (rec["step"], field)
+    assert records[-1]["guard_notfinite_count"] == 1
+    assert records[-1]["guard_step"] == 6
+    assert guard_report(state)["notfinite_count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# effective wire bytes: dense <-> compressed flip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.telemetry
+def test_effective_wire_bytes_flip_across_fallback_window(mesh):
+    """Forcing the fallback flag flips the recorded wire bytes to the
+    escape codec's dense cost and back after re-arm — fallback windows
+    show their true communication price."""
+    x, y = _problem()
+    params = dict(TOPK_TELEM, escape="fp16", telemetry=32)
+    state, step = _build(mesh, params)
+
+    leaves = jax.tree_util.tree_leaves(_init_params())
+    from grace_tpu.compressors import FP16Compressor, TopKCompressor
+    esc_bytes = sum(payload_nbytes(FP16Compressor(), l) for l in leaves)
+    comp_bytes = sum(payload_nbytes(TopKCompressor(compress_ratio=0.3), l)
+                     for l in leaves)
+    assert esc_bytes != comp_bytes
+
+    reader = TelemetryReader(sink=None, every=100)
+    for _ in range(3):
+        state, _ = step(state, (x, y))
+    state = set_fallback_flag(state, True)     # force the dense window
+    for _ in range(3):
+        state, _ = step(state, (x, y))
+    state = set_fallback_flag(state, False)    # re-arm
+    for _ in range(3):
+        state, _ = step(state, (x, y))
+
+    records = reader.flush(state)
+    wire = [r["wire_bytes"] for r in records]
+    flags = [r["fallback"] for r in records]
+    err = [r["compression_error"] for r in records]
+    assert wire == [comp_bytes] * 3 + [esc_bytes] * 3 + [comp_bytes] * 3
+    assert flags == [0.0] * 3 + [1.0] * 3 + [0.0] * 3
+    # During the dense window the codec is bypassed: effective error ~0.
+    assert all(e == 0.0 for e in err[3:6])
+    assert all(e > 0.0 for e in err[:3] + err[6:])
+    assert all(r["dense_bytes"] == sum(l.size * 4 for l in leaves)
+               for r in records)
+
+
+# ---------------------------------------------------------------------------
+# GuardMonitor transition edges + sink wiring
+# ---------------------------------------------------------------------------
+
+def _report(nf=0, fb_remaining=0, consecutive=0, step=0):
+    return {"step": step, "notfinite_count": nf, "last_bad_step": -1,
+            "consecutive": consecutive, "fallback_remaining": fb_remaining,
+            "fallback_active": fb_remaining > 0}
+
+
+@pytest.mark.telemetry
+def test_guard_monitor_transition_edges():
+    """Re-arm must fire on the EXACT boundary step: the first report whose
+    fallback_active drops to False, not one step later (and never twice)."""
+    sink = _ListSink()
+    lines = []
+    mon = GuardMonitor(printer=lambda *a: lines.append(" ".join(map(str, a))),
+                       sink=sink)
+    reports = [
+        _report(step=0),                                   # healthy
+        _report(step=1, nf=1, consecutive=1),              # skip
+        _report(step=2, nf=2, consecutive=2, fb_remaining=3),  # engage
+        _report(step=3, nf=2, fb_remaining=2),             # dense window
+        _report(step=4, nf=2, fb_remaining=1),
+        _report(step=5, nf=2, fb_remaining=0),             # re-arm boundary
+        _report(step=6, nf=2),                             # stays quiet
+    ]
+    for i, rep in enumerate(reports):
+        mon.update(i, rep)
+
+    events = [(r["event"], r["step"]) for r in sink.records]
+    assert ("guard_skip", 1) in events
+    assert ("guard_skip", 2) in events
+    assert ("guard_fallback_engaged", 2) in events
+    assert events.count(("guard_rearmed", 5)) == 1
+    assert not any(e == "guard_rearmed" and s != 5 for e, s in events)
+    assert any("re-armed" in l for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+@pytest.mark.telemetry
+def test_jsonl_sink_header_once_and_multisink(tmp_path):
+    path = tmp_path / "s.jsonl"
+    other = _ListSink()
+    sink = MultiSink(JSONLSink(path, provenance={"data": "synthetic"}),
+                     other)
+    sink.write({"step": 0, "loss": 1.5})
+    sink.write({"step": 1, "loss": np.float32(1.25)})  # numpy scalars ok
+    sink.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines[0] == {"provenance": {"data": "synthetic"}}
+    assert lines[1:] == [{"step": 0, "loss": 1.5},
+                         {"step": 1, "loss": 1.25}]
+    assert len(other.records) == 2
+    with pytest.raises(ValueError):
+        sink.sinks[0].write({"step": 2})
+
+
+@pytest.mark.telemetry
+def test_tensorboard_sink_writes_valid_event_frames(tmp_path):
+    logdir = tmp_path / "tb"
+    with TensorBoardSink(logdir, tag_prefix="grace") as sink:
+        sink.write({"step": 3, "loss": 0.5, "note": "skipped-nonnumeric"})
+        sink.write({"step": 4, "grad_norm": 1.25})
+    files = list(logdir.glob("events.out.tfevents.*"))
+    assert len(files) == 1
+    data = files[0].read_bytes()
+
+    events = []
+    off = 0
+    while off < len(data):
+        (length,) = struct.unpack_from("<Q", data, off)
+        (len_crc,) = struct.unpack_from("<I", data, off + 8)
+        assert len_crc == masked_crc(data[off:off + 8])
+        payload = data[off + 12:off + 12 + length]
+        (data_crc,) = struct.unpack_from("<I", data, off + 12 + length)
+        assert data_crc == masked_crc(payload)
+        events.append(payload)
+        off += 12 + length + 4
+    assert off == len(data)            # no trailing garbage
+    assert b"brain.Event:2" in events[0]
+    assert b"grace/loss" in events[1]
+    assert b"note" not in events[1]    # non-numeric fields skipped
+    assert b"grace/grad_norm" in events[2]
+
+
+# ---------------------------------------------------------------------------
+# chaos_smoke telemetry artifact (CI wiring)
+# ---------------------------------------------------------------------------
+
+def _load_tool(name):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test",
+        os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.chaos
+@pytest.mark.telemetry
+def test_chaos_smoke_writes_telemetry_artifact(tmp_path):
+    """The smoke tool must leave a non-empty, provenance-stamped telemetry
+    JSONL behind — the artifact CI archives for every resilience run."""
+    smoke = _load_tool("chaos_smoke")
+    out = tmp_path / "chaos_telemetry.jsonl"
+    rc = smoke.main(["--steps", "12", "--nan-prob", "1.0", "--batch", "16",
+                     "--fallback-after", "2", "--fallback-steps", "4",
+                     "--telemetry-out", str(out), "--telemetry-every", "6"])
+    assert rc == 0
+
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert lines, "telemetry artifact is empty"
+    assert lines[0]["provenance"]["tool"] == "chaos_smoke"
+    assert lines[-1], "last telemetry record is empty"
+    metric_rows = [l for l in lines[1:] if "grad_norm" in l]
+    assert metric_rows, "no per-step metric rows in the artifact"
+    for rec in metric_rows:
+        for field in REQUIRED:
+            assert field in rec, field
+    # nan_prob=1.0: every accepted step ran inside a dense-fallback window,
+    # and the guard's transition events landed in the same stream.
+    assert all(r["fallback"] == 1.0 for r in metric_rows)
+    events = {l["event"] for l in lines[1:] if "event" in l}
+    assert "guard_skip" in events and "guard_fallback_engaged" in events
+
+
+# ---------------------------------------------------------------------------
+# report tool
+# ---------------------------------------------------------------------------
+
+@pytest.mark.telemetry
+def test_telemetry_report_renders_summary(tmp_path, capsys):
+    report = _load_tool("telemetry_report")
+    path = tmp_path / "r.jsonl"
+    sink = JSONLSink(path, provenance={"data": "synthetic",
+                                       "git_commit": "abc123"})
+    for i in range(6):
+        fb = 1.0 if i in (2, 3) else 0.0
+        sink.write({"step": i, "grad_norm": 1.0 + i, "update_norm": 1.0,
+                    "residual_norm": 0.1, "residual_max": 0.2,
+                    "compression_error": 0.0 if fb else 0.4,
+                    "wire_bytes": 168.0 if fb else 200.0,
+                    "dense_bytes": 336.0, "fallback": fb})
+    sink.write({"event": "guard_skip", "step": 2, "notfinite_count": 1})
+    sink.close()
+
+    provenance, records, events = report.load(str(path))
+    assert len(records) == 6 and len(events) == 1
+    text = report.render(provenance, records, events)
+    assert "git_commit: abc123" in text
+    assert "grad_norm" in text and "compression_error" in text
+    assert "dense-fallback windows (recorded steps): 2..3" in text
+    assert "ratio 0.5635" in text          # (4*200+2*168)/(6*336)
+    assert "guard_skip" in text
+    assert report.main([str(path)]) == 0
+    capsys.readouterr()                    # swallow the printed report
+
+
+# ---------------------------------------------------------------------------
+# field registry sanity
+# ---------------------------------------------------------------------------
+
+def test_fields_registry_matches_required():
+    assert tuple(name for name, _ in FIELDS) == REQUIRED
+    assert all(agg in ("mean", "max", "first") for _, agg in FIELDS)

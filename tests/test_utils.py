@@ -37,10 +37,27 @@ class TestTableLogger:
         log.append({"epoch": 1, "loss": 0.5})
         log.append({"epoch": 2, "loss": 0.25, "extra": "ignored"})
         lines = buf.getvalue().strip().split("\n")
-        assert len(lines) == 3
+        assert len(lines) == 4   # header, row, new-column notice, row
         assert "epoch" in lines[0] and "loss" in lines[0]
-        assert "ignored" not in lines[2]  # keys latched from first row
-        assert "0.2500" in lines[2]
+        assert lines[2] == "# new columns (ignored): extra"
+        assert "ignored" not in lines[3]  # keys latched from first row
+        assert "0.2500" in lines[3]
+
+    def test_missing_key_renders_blank_and_new_key_warns_once(self):
+        # The telemetry case: fields appear only after the first flush
+        # window and early rows lack them — neither may KeyError.
+        buf = io.StringIO()
+        log = TableLogger(width=8, stream=buf)
+        log.append({"epoch": 1, "loss": 0.5})
+        log.append({"epoch": 2})                           # lost a key
+        log.append({"epoch": 3, "loss": 0.1, "gnorm": 1.0})  # gained one
+        log.append({"epoch": 4, "loss": 0.2, "gnorm": 2.0})  # no re-warn
+        lines = buf.getvalue().split("\n")
+        notices = [l for l in lines if l.startswith("#")]
+        assert notices == ["# new columns (ignored): gnorm"]
+        row2 = lines[2]
+        assert row2.startswith(f"{2:>8}") and row2.rstrip() == f"{2:>8}"
+        assert all("gnorm" not in l for l in lines if not l.startswith("#"))
 
 
 class TestTSVLogger:
@@ -157,6 +174,38 @@ class TestWireMetrics:
         x = jnp.zeros((1000,), jnp.float32)
         b = payload_nbytes(C.RandomKCompressor(compress_ratio=0.01), x)
         assert b == 10 * 4
+
+
+def test_debug_nan_residuals_counts_nan_and_inf():
+    """The census reports NaN AND Inf per leaf (~jnp.isfinite), in one
+    device-to-host transfer; clean states stay an empty dict."""
+    from grace_tpu.utils import debug_nan_residuals
+
+    clean = {"a": jnp.zeros((4,)), "n": jnp.arange(3)}   # int leaf ignored
+    assert debug_nan_residuals(clean) == {}
+
+    poisoned = {
+        "a": jnp.asarray([1.0, jnp.nan, jnp.inf, -jnp.inf]),
+        "b": {"c": jnp.asarray([jnp.nan, jnp.nan])},
+        "ok": jnp.ones((2,)),
+    }
+    rep = debug_nan_residuals(poisoned)
+    assert set(rep) == {"['a']", "['b']['c']"}
+    assert rep["['a']"] == {"nan": 1, "inf": 2}
+    assert rep["['b']['c']"] == {"nan": 2, "inf": 0}
+
+
+def test_run_provenance_includes_git_commit():
+    from grace_tpu.utils import git_commit, run_provenance
+
+    prov = run_provenance("synthetic", argv="--steps 5")
+    assert prov["data"] == "synthetic"
+    assert prov["argv"] == "--steps 5"
+    # This repo IS a git checkout, so the best-effort lookup must succeed
+    # here and match the helper.
+    rev = git_commit()
+    assert rev and prov["git_commit"] == rev
+    assert 4 <= len(rev) <= 16 and all(c in "0123456789abcdef" for c in rev)
 
 
 def test_wire_report_powersgd_analytic():

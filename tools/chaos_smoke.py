@@ -7,6 +7,13 @@ LeNet on synthetic MNIST-shaped data for --steps steps on the 8-device mesh
 with a --nan-prob per-(step, leaf) NaN implant on one rank
 (``ChaosCommunicator``), under the full guard + dense-fallback stack.
 
+Telemetry (ISSUE 2): the run records the in-graph telemetry ring
+(grad/update norms, residual health, compression error, effective wire
+bytes across the dense-fallback flip) and drains it through a provenance-
+stamped JSONL artifact at --telemetry-out, with guard transitions emitted
+into the same stream by ``GuardMonitor(sink=...)``. Render it with
+``python tools/telemetry_report.py <artifact>``.
+
 Exit status (for CI):
   0  final loss is finite AND the guard tripped at least once
   1  final loss is non-finite (the guard failed to contain the faults), or
@@ -30,7 +37,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> int:
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--nan-prob", type=float, default=0.01,
@@ -43,7 +50,11 @@ def main() -> int:
     ap.add_argument("--fallback-after", type=int, default=3)
     ap.add_argument("--fallback-steps", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0)
-    args = ap.parse_args()
+    ap.add_argument("--telemetry-out", default="chaos_telemetry.jsonl",
+                    help="JSONL telemetry artifact path ('' disables)")
+    ap.add_argument("--telemetry-every", type=int, default=25,
+                    help="steps per telemetry flush (one device_get each)")
+    args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -52,7 +63,13 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
         from grace_tpu.parallel import (relax_cpu_collective_timeouts,
                                         set_cpu_device_count)
-        set_cpu_device_count(8)
+        try:
+            set_cpu_device_count(8)
+        except RuntimeError:
+            # Backend already initialized — e.g. main() invoked from the
+            # pytest harness, whose conftest set the 8-device mesh up
+            # before any test ran. Reuse its devices.
+            pass
         relax_cpu_collective_timeouts()
 
     import jax.numpy as jnp
@@ -63,8 +80,9 @@ def main() -> int:
     from grace_tpu.models import lenet
     from grace_tpu.parallel import data_parallel_mesh
     from grace_tpu.resilience import ChaosCommunicator, guarded_chain
+    from grace_tpu.telemetry import JSONLSink, TelemetryReader
     from grace_tpu.train import init_train_state, make_train_step
-    from grace_tpu.utils.logging import GuardMonitor
+    from grace_tpu.utils.logging import GuardMonitor, run_provenance
     from grace_tpu.utils.metrics import guard_report
 
     mesh = data_parallel_mesh()
@@ -84,7 +102,10 @@ def main() -> int:
     grc = grace_from_params({"compressor": "topk", "compress_ratio": 0.3,
                              "memory": "residual",
                              "communicator": "allgather",
-                             "escape": "fp16"})
+                             "escape": "fp16",
+                             # ring sized to the flush window so a healthy
+                             # run never wraps between flushes
+                             "telemetry": max(2 * args.telemetry_every, 16)})
     grc = dataclasses.replace(grc, communicator=ChaosCommunicator(
         inner=grc.communicator, nan_prob=args.nan_prob, rank=args.rank,
         seed=args.seed + 1))
@@ -96,7 +117,18 @@ def main() -> int:
     state = init_train_state(params, tx, mesh)
     step = make_train_step(loss_fn, tx, mesh, donate=False)
 
-    monitor = GuardMonitor()
+    sink = None
+    reader = None
+    if args.telemetry_out:
+        sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+            data="synthetic",
+            tool="chaos_smoke",
+            argv=" ".join(sys.argv[1:]),
+            nan_prob=args.nan_prob, steps=args.steps,
+            fallback_after=args.fallback_after,
+            fallback_steps=args.fallback_steps))
+        reader = TelemetryReader(sink, every=args.telemetry_every)
+    monitor = GuardMonitor(sink=sink)
     t0 = time.perf_counter()
     loss = float("nan")
     for i in range(args.steps):
@@ -105,8 +137,15 @@ def main() -> int:
              jnp.asarray(labels[lo:lo + batch]))
         state, loss = step(state, b)
         monitor.update(i, guard_report(state))
+        if reader is not None:
+            reader.update(i, state)
     loss = float(loss)
     dt = time.perf_counter() - t0
+    if reader is not None:
+        reader.flush(state)      # drain the tail window
+        reader.close()
+        print(f"[chaos_smoke] telemetry artifact: {args.telemetry_out} "
+              f"({reader.flushes} flushes, {reader.dropped} dropped rows)")
 
     rep = guard_report(state)
     print(f"[chaos_smoke] {args.steps} steps in {dt:.1f}s | final loss "

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL run log as a per-stage / per-metric summary.
+
+Input: a file written by ``grace_tpu.telemetry.JSONLSink`` — a provenance
+header line followed by per-step metric records
+(``TelemetryReader``) and guard-transition events (``GuardMonitor``).
+
+Output (text, stdout): the provenance block, a per-metric stats table
+(count / mean / min / max / last over the per-step records), wire-traffic
+accounting including dense-fallback windows reconstructed from the
+``fallback`` flag flips, and the guard event log. Pure stdlib — usable on
+any box that holds the artifact, no jax required.
+
+Usage::
+
+    python tools/telemetry_report.py chaos_telemetry.jsonl
+    python tools/telemetry_report.py run.jsonl --metrics grad_norm,wire_bytes
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+# Metric columns in display order; anything else numeric found in records
+# is appended after these.
+PREFERRED = ["grad_norm", "update_norm", "residual_norm", "residual_max",
+             "compression_error", "wire_bytes", "dense_bytes", "fallback"]
+
+
+def load(path: str):
+    provenance, records, events = None, [], []
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"[telemetry_report] {path}:{lineno}: bad JSON "
+                      f"({e}); skipping", file=sys.stderr)
+                continue
+            if "provenance" in obj and provenance is None:
+                provenance = obj["provenance"]
+            elif "event" in obj:
+                events.append(obj)
+            else:
+                records.append(obj)
+    return provenance, records, events
+
+
+def _stats(values: List[float]) -> dict:
+    return {"count": len(values),
+            "mean": sum(values) / len(values),
+            "min": min(values),
+            "max": max(values),
+            "last": values[-1]}
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return f"{int(v):>12,d}"
+    return f"{v:>12.6g}"
+
+
+def fallback_windows(records: List[dict]) -> List[tuple]:
+    """[(first_step, last_step), ...] of contiguous fallback==1 records."""
+    windows, start, prev = [], None, None
+    for rec in records:
+        if rec.get("fallback"):
+            if start is None:
+                start = rec["step"]
+            prev = rec["step"]
+        elif start is not None:
+            windows.append((start, prev))
+            start = None
+    if start is not None:
+        windows.append((start, prev))
+    return windows
+
+
+def render(provenance, records, events,
+           metrics: Optional[List[str]] = None) -> str:
+    out = []
+    out.append("== provenance ==")
+    if provenance:
+        for k, v in provenance.items():
+            out.append(f"  {k}: {v}")
+    else:
+        out.append("  (no provenance header — was this file written by "
+                   "JSONLSink?)")
+
+    out.append("")
+    out.append(f"== per-step metrics ({len(records)} records) ==")
+    if records:
+        steps = [r["step"] for r in records if "step" in r]
+        if steps:
+            out.append(f"  steps {min(steps)}..{max(steps)}")
+        dropped = sum(r.get("dropped_steps", 0) for r in records)
+        if dropped:
+            out.append(f"  ring-wraparound dropped rows: {dropped} "
+                       "(flush interval exceeded telemetry capacity)")
+        numeric = [k for k in records[-1]
+                   if isinstance(records[-1][k], (int, float))
+                   and not isinstance(records[-1][k], bool)
+                   and k != "step"]
+        cols = [m for m in (metrics or PREFERRED) if any(m in r
+                                                         for r in records)]
+        cols += [k for k in sorted(numeric)
+                 if k not in cols and metrics is None]
+        head = f"  {'metric':<24s}{'count':>8s}" + "".join(
+            f"{h:>13s}" for h in ("mean", "min", "max", "last"))
+        out.append(head)
+        for m in cols:
+            vals = [float(r[m]) for r in records if m in r]
+            if not vals:
+                continue
+            s = _stats(vals)
+            out.append(f"  {m:<24s}{s['count']:>8d}"
+                       + "".join(" " + _fmt(s[k])
+                                 for k in ("mean", "min", "max", "last")))
+
+        wire = [float(r["wire_bytes"]) for r in records if "wire_bytes" in r]
+        dense = [float(r["dense_bytes"]) for r in records
+                 if "dense_bytes" in r]
+        if wire and dense:
+            out.append("")
+            out.append("== wire traffic ==")
+            out.append(f"  effective payload bytes, total: "
+                       f"{int(sum(wire)):,d} (dense would be "
+                       f"{int(sum(dense)):,d}; ratio "
+                       f"{sum(wire) / max(sum(dense), 1):.4f})")
+            wins = fallback_windows(records)
+            if wins:
+                spans = ", ".join(f"{a}..{b}" for a, b in wins)
+                out.append(f"  dense-fallback windows (recorded steps): "
+                           f"{spans}")
+            else:
+                out.append("  dense-fallback windows: none")
+            out.append("  (logical payload bytes — XLA may pad/repack on "
+                       "the wire; treat as the algorithmic lower bound, "
+                       "see grace_tpu/utils/metrics.py)")
+        guard_keys = sorted(k for k in records[-1] if k.startswith("guard_"))
+        if guard_keys:
+            out.append("")
+            out.append("== guard counters (at last flush) ==")
+            for k in guard_keys:
+                out.append(f"  {k}: {records[-1][k]}")
+    else:
+        out.append("  (none)")
+
+    out.append("")
+    out.append(f"== guard events ({len(events)}) ==")
+    for e in events:
+        extras = {k: v for k, v in e.items() if k not in ("event", "step")}
+        brief = ", ".join(f"{k}={v}" for k, v in sorted(extras.items())
+                          if isinstance(v, (int, float, bool)))
+        out.append(f"  step {e.get('step', '?'):>6}: {e['event']}"
+                   + (f"  ({brief})" if brief else ""))
+    if not events:
+        out.append("  (none)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="telemetry JSONL file (JSONLSink output)")
+    ap.add_argument("--metrics", default=None,
+                    help="comma-separated metric subset to summarize")
+    args = ap.parse_args(argv)
+    provenance, records, events = load(args.path)
+    metrics = args.metrics.split(",") if args.metrics else None
+    print(render(provenance, records, events, metrics))
+    return 0 if (records or events) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
