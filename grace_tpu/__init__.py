@@ -11,10 +11,13 @@ reference.
 
 from grace_tpu.core import Communicator, Compressor, Memory
 from grace_tpu.comm import (Allgather, Allreduce, Broadcast, Identity,
-                            SignAllreduce, TwoShotAllreduce)
+                            SignAllreduce, TwoShotAllreduce,
+                            masked_broadcast)
 from grace_tpu.helper import Grace, grace_from_params
 from grace_tpu.resilience import (ChaosCommunicator, ChaosCompressor,
-                                  GuardState, guard_transform, guarded_chain)
+                                  ChaosParams, ConsensusConfig, GuardState,
+                                  audit_report, consensus_step,
+                                  guard_transform, guarded_chain)
 from grace_tpu.telemetry import (JSONLSink, MultiSink, TelemetryConfig,
                                  TelemetryReader, TelemetryState,
                                  TensorBoardSink, trace_stage)
@@ -31,7 +34,8 @@ __all__ = [
     "TwoShotAllreduce",
     "Grace", "grace_from_params", "grace_transform", "GraceState",
     "GuardState", "guard_transform", "guarded_chain",
-    "ChaosCompressor", "ChaosCommunicator",
+    "ChaosCompressor", "ChaosCommunicator", "ChaosParams",
+    "ConsensusConfig", "consensus_step", "audit_report", "masked_broadcast",
     "TelemetryConfig", "TelemetryState", "TelemetryReader",
     "JSONLSink", "TensorBoardSink", "MultiSink", "trace_stage",
     "TrainState", "init_train_state", "make_train_step", "make_eval_step",

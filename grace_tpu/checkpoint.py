@@ -19,13 +19,39 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional, Tuple
+import time
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 __all__ = ["Checkpointer", "save_checkpoint", "restore_checkpoint",
            "latest_step", "divergence_rollback"]
+
+# Transient-IO retry policy for save-path writes (shared by the orbax save
+# dispatch and the last-known-good sidecar): a preempted node's NFS blip or
+# an ENOSPC race should not silently drop a checkpoint the divergence-
+# rollback path later depends on. Bounded exponential backoff; the final
+# failure propagates.
+_IO_RETRIES = 3
+_IO_BACKOFF_S = 0.1
+
+
+def _retry_io(fn: Callable[[], Any], what: str,
+              retries: int = _IO_RETRIES,
+              backoff_s: float = _IO_BACKOFF_S) -> Any:
+    """Run ``fn``, retrying transient ``OSError``s with exponential backoff.
+
+    Only OS-level errors are retried — anything else (structure mismatch,
+    orbax value errors) is a programming error and raises immediately.
+    """
+    for attempt in range(retries):
+        try:
+            return fn()
+        except OSError:
+            if attempt == retries - 1:
+                raise
+            time.sleep(backoff_s * (2 ** attempt))
 
 
 def _path_names(entry) -> str:
@@ -107,12 +133,28 @@ class Checkpointer:
             return []
 
     def _write_good(self, steps: list) -> None:
+        """Atomic, retryable sidecar write: temp file + fsync + os.replace.
+
+        A preemption mid-write leaves at worst a stale ``.tmp`` next to an
+        intact previous record — never a torn ``last_known_good.json``,
+        which would blind :meth:`restore_last_good` exactly when the
+        divergence-rollback path needs it. Transient IO errors retry with
+        bounded backoff (:func:`_retry_io`).
+        """
         if jax.process_index() != 0:
             return
+        payload = json.dumps(
+            {"good_steps": sorted(set(int(s) for s in steps))})
         tmp = self._good_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"good_steps": sorted(set(int(s) for s in steps))}, f)
-        os.replace(tmp, self._good_path)
+
+        def write():
+            with open(tmp, "w") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._good_path)
+
+        _retry_io(write, "last-known-good sidecar")
 
     def save(self, step: int, state: Any, force: bool = False,
              good: Optional[bool] = None) -> bool:
@@ -120,9 +162,18 @@ class Checkpointer:
 
         ``good`` marks (True) or unmarks (False) this step as known-good in
         the per-step metadata; ``None`` leaves the record untouched.
+
+        Atomicity/durability: orbax itself stages each step into a
+        temporary directory and renames on commit, so a preemption mid-save
+        never exposes a torn step; the save *dispatch* and the known-good
+        sidecar here additionally retry transient ``OSError``s with bounded
+        backoff, so one IO blip doesn't silently drop the rollback
+        candidate.
         """
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
-                               force=force)
+        saved = _retry_io(
+            lambda: self._mgr.save(step, args=ocp.args.StandardSave(state),
+                                   force=force),
+            f"checkpoint save at step {step}")
         if good is not None and saved:
             self.mark_good(step, good)
         return saved

@@ -31,7 +31,8 @@ from grace_tpu.telemetry.scopes import (STAGE_DECOMPRESS, STAGE_EXCHANGE,
                                         trace_stage)
 
 __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
-           "SignAllreduce", "TwoShotAllreduce"]
+           "SignAllreduce", "TwoShotAllreduce",
+           "masked_broadcast", "masked_broadcast_tree"]
 
 
 # XLA-TPU layout pathology guard (observed on BERT-base, 2026-08-01): a
@@ -76,6 +77,47 @@ def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
         summed = _psum(dec.astype(vote_dtype), axis_name)
     out = (summed >= 0).astype(vote_dtype) * 2 - 1
     return out.astype(dec.dtype)
+
+
+_MB_UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def masked_broadcast(x: jax.Array, root, axis_name: str) -> jax.Array:
+    """Bit-exact broadcast of rank ``root``'s value over ``axis_name``.
+
+    Realised as an ``lax.axis_index``-masked psum in *integer bit space*:
+    the value is reinterpreted as unsigned words, every rank except ``root``
+    contributes zeros, and the integer sum reconstructs root's words exactly.
+    A float-space masked psum would NOT be bit-exact (``-0.0 + 0.0 == +0.0``
+    flips the sign bit, and NaN payloads are not preserved through float
+    adds), which matters because the consensus repair path
+    (:mod:`grace_tpu.resilience.consensus`) must leave replicas
+    *bit-identical* — fingerprints are bit-pattern checksums.
+
+    ``root`` may be a static int or a traced (replicated) scalar. Must be
+    called where ``axis_name`` is bound (inside ``shard_map``/``pjit``).
+    """
+    x = jnp.asarray(x)
+    i = lax.axis_index(axis_name)
+    is_root = (i == root)
+    if x.dtype == jnp.bool_:
+        v = x.astype(jnp.uint8)
+        out = lax.psum(jnp.where(is_root, v, jnp.zeros_like(v)), axis_name)
+        return out != 0
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        masked = jnp.where(is_root, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis_name)
+    uint = _MB_UINT[x.dtype.itemsize]
+    bits = lax.bitcast_convert_type(x, uint)
+    summed = lax.psum(jnp.where(is_root, bits, jnp.zeros_like(bits)),
+                      axis_name)
+    return lax.bitcast_convert_type(summed, x.dtype)
+
+
+def masked_broadcast_tree(tree, root, axis_name: str):
+    """:func:`masked_broadcast` over every array leaf of a pytree."""
+    return jax.tree_util.tree_map(
+        lambda l: masked_broadcast(l, root, axis_name), tree)
 
 
 @dataclasses.dataclass(frozen=True)

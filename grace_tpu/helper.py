@@ -42,12 +42,18 @@ class Grace:
                          # hatch (see grace_transform / resilience.guard)
     telemetry: Any = None  # None | True | capacity | dict | TelemetryConfig:
                            # in-graph telemetry ring (grace_tpu.telemetry)
+    consensus: Any = None  # None | True | audit_every | dict |
+                           # ConsensusConfig: cross-rank consistency audit
+                           # (grace_tpu.resilience.consensus). Arms the
+                           # AuditState here; pass the same value to
+                           # make_train_step(consensus=...) for the hook.
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,
                                self.communicator, seed=seed,
                                fusion=self.fusion, escape=self.escape,
-                               telemetry=self.telemetry)
+                               telemetry=self.telemetry,
+                               consensus=self.consensus)
 
 
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
@@ -173,4 +179,7 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
                  escape=escape,
                  # True | ring capacity | {"capacity": ..,
                  # "compression_error": ..} — see grace_transform(telemetry=)
-                 telemetry=params.get("telemetry"))
+                 telemetry=params.get("telemetry"),
+                 # True | audit_every | {"audit_every": .., "escalate_*": ..}
+                 # — see grace_transform(consensus=) / resilience.consensus
+                 consensus=params.get("consensus"))

@@ -14,7 +14,13 @@ it (SURVEY.md has no counterpart — the reference assumes a fault-free run):
   for M cooldown steps, then compression re-arms.
 * :mod:`~grace_tpu.resilience.chaos` — deterministic fault injectors
   (NaN/Inf implants, payload bit-flips, single-rank faults, stale
-  residuals) as Compressor/Communicator wrappers.
+  residuals) as Compressor/Communicator wrappers, plus
+  :class:`ChaosParams`, a host-side single-rank SDC injector for
+  params/opt-state at rest.
+* :mod:`~grace_tpu.resilience.consensus` — the cross-rank consistency
+  auditor + in-graph self-healing (fingerprint → compare → masked-psum
+  repair → escalate), for the silent single-rank divergence the guard's
+  post-exchange checks are structurally blind to.
 """
 
 from __future__ import annotations
@@ -23,11 +29,17 @@ from typing import Optional
 
 import optax
 
-from grace_tpu.resilience.chaos import ChaosCommunicator, ChaosCompressor
+from grace_tpu.resilience.chaos import (ChaosCommunicator, ChaosCompressor,
+                                        ChaosParams)
+from grace_tpu.resilience.consensus import (ConsensusConfig, audit_report,
+                                            consensus_step, fingerprint_tree,
+                                            normalize_consensus)
 from grace_tpu.resilience.guard import GuardState, guard_transform
 
 __all__ = ["GuardState", "guard_transform", "guarded_chain",
-           "ChaosCompressor", "ChaosCommunicator"]
+           "ChaosCompressor", "ChaosCommunicator", "ChaosParams",
+           "ConsensusConfig", "consensus_step", "fingerprint_tree",
+           "audit_report", "normalize_consensus"]
 
 
 def guarded_chain(grace, *txs: optax.GradientTransformation,

@@ -20,6 +20,14 @@ Fault classes (ScaleCom-style stability probes, PAPERS.md):
 * **Stale residuals** — suppress this step's error-feedback state update so
   the memory replays last step's residual, modelling a lost/duplicated
   update in a sharded state store.
+* **Single-rank SDC in params/opt-state** (:class:`ChaosParams`) — a
+  host-side wrapper that, *between* steps, flips one bit of one element of
+  a replicated state leaf in exactly ONE device's buffer. This models
+  silent data corruption (bad HBM, a cosmic-ray bitflip) landing in state
+  that every rank assumes is shared: the corruption is perfectly finite,
+  the exchanged updates stay rank-identical, so the PR-1 guard never trips
+  — the fault class the consensus auditor
+  (:mod:`grace_tpu.resilience.consensus`) exists to catch.
 
 The wrappers deliberately do NOT forward the fused-kernel hooks
 (``fused_feedback_compress`` / ``fused_aggregate_decompress``): the fused
@@ -34,12 +42,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from grace_tpu.core import (Communicator, Compressor, Ctx, Memory, Payload,
                             State)
 
-__all__ = ["ChaosCompressor", "ChaosCommunicator"]
+__all__ = ["ChaosCompressor", "ChaosCommunicator", "ChaosParams"]
 
 _UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
@@ -198,3 +207,84 @@ class ChaosCommunicator(Communicator):
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:
         return self.inner.exchange(payload, ctx, compressor)
+
+
+@dataclasses.dataclass
+class ChaosParams:
+    """Host-side single-rank SDC injector for params / optimizer state.
+
+    The Compressor/Communicator wrappers above corrupt *in-flight* values;
+    this one corrupts *state at rest*, between steps, on exactly one
+    device's copy of a replicated leaf — the silent-corruption fault the
+    in-graph guard is structurally blind to (finite values, rank-identical
+    updates). Usage::
+
+        chaos = ChaosParams(rank=3, at_steps=(10,), seed=7)
+        for i, batch in enumerate(batches):
+            state = chaos(state, i)        # maybe-corrupt BEFORE the step
+            state, loss = step(state, batch)
+
+    Mechanics: on a hit step, pick one floating leaf of ``target`` (an
+    attribute name on the state NamedTuple, e.g. ``"params"`` /
+    ``"opt_state"``; ``None`` corrupts anywhere in the whole state), one
+    element, one bit — all from ``numpy.random.default_rng(seed ^ step)``
+    so runs are reproducible — and flip that bit in device ``rank``'s
+    buffer only, reassembling the array with
+    ``jax.make_array_from_single_device_arrays`` under its original
+    (replicated) sharding. The other replicas keep their bytes, so the
+    array *claims* replication while its buffers disagree: exactly what
+    SDC looks like to SPMD code. Every injection is appended to
+    :attr:`injections` as ``(step, leaf_index, element, bit)``.
+    """
+
+    rank: int = 0
+    at_steps: tuple = ()
+    prob: float = 0.0
+    seed: int = 0
+    target: Optional[str] = "params"
+
+    def __post_init__(self):
+        self.injections: list = []
+
+    def _hit(self, step: int, rng) -> bool:
+        if step in tuple(self.at_steps):
+            return True
+        return bool(self.prob) and rng.random() < self.prob
+
+    def __call__(self, state, step: int):
+        rng = np.random.default_rng((self.seed << 20) ^ step)
+        if not self._hit(step, rng):
+            return state
+        sub = state if self.target is None else getattr(state, self.target)
+        leaves, treedef = jax.tree_util.tree_flatten(sub)
+        float_idx = [i for i, l in enumerate(leaves)
+                     if hasattr(l, "dtype")
+                     and jnp.issubdtype(l.dtype, jnp.floating)
+                     and l.size > 0]
+        if not float_idx:
+            return state
+        li = int(rng.choice(float_idx))
+        arr = leaves[li]
+        shards = list(arr.addressable_shards)
+        if self.rank >= len(shards):
+            raise ValueError(
+                f"ChaosParams(rank={self.rank}) but the target leaf has "
+                f"only {len(shards)} addressable shards — SDC injection "
+                "needs a replicated leaf with one shard per device.")
+        pos = int(rng.integers(arr.size))
+        bit = int(rng.integers(np.dtype(arr.dtype).itemsize * 8))
+        uint = np.dtype(f"uint{np.dtype(arr.dtype).itemsize * 8}")
+        bufs = []
+        for si, s in enumerate(shards):
+            data = np.array(s.data)           # per-device copy
+            if si == self.rank:
+                flat = data.reshape(-1).view(uint)
+                flat[pos] ^= uint.type(1) << uint.type(bit)
+            bufs.append(jax.device_put(data, s.device))
+        leaves[li] = jax.make_array_from_single_device_arrays(
+            arr.shape, arr.sharding, bufs)
+        self.injections.append((step, li, pos, bit))
+        sub = jax.tree_util.tree_unflatten(treedef, leaves)
+        if self.target is None:
+            return sub
+        return state._replace(**{self.target: sub})

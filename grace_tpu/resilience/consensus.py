@@ -1,0 +1,462 @@
+"""Cross-rank consistency audit + in-graph self-healing for replica divergence.
+
+Error-feedback compression is only correct if every replica holds consistent
+state: params, the downstream optimizer state, and the replicated GraceState
+scalars (count, rng_key, fallback) must be **bit-identical** across ranks —
+ScaleCom and PowerSGD (PAPERS.md) both hinge on exactly this cross-worker
+state consistency, because every rank derives its compression decisions from
+state it assumes is shared. ``GraceState.mem``/``comp`` residuals are
+legitimately per-rank, but everything else drifting on a single rank is a
+*silent* fault class the PR-1 guard cannot see:
+
+* the guard checks the **post-exchange update** for NaN/Inf/norm bounds —
+  a bit-flipped parameter is perfectly finite, and because the exchange
+  aggregates gradients, the *updates* stay rank-identical while the
+  *params* stay diverged forever;
+* a single-rank SDC (bitflip in params/opt-state, the fault
+  :class:`~grace_tpu.resilience.chaos.ChaosParams` injects) therefore
+  desynchronizes replicas permanently without ever tripping the guard.
+
+This module closes that gap with three in-graph pieces:
+
+**Fingerprint** (:func:`fingerprint_tree`): fold the replicated state into a
+small per-rank vector — a segmented *float fold* (value sums, magnitude-
+sensitive) plus a position-weighted *bit-pattern checksum* (so ``-0.0`` vs
+``+0.0`` and differing NaN payloads cannot alias; the final comparison is
+done entirely on the bit vectors, which also sidesteps NaN != NaN). Cost:
+one pass over the state every ``audit_every`` steps, gated by ``lax.cond``
+on ``GraceState.count`` so healthy non-audit steps pay ~nothing.
+
+**Audit**: ``all_gather`` the fingerprints over the world axis (a few dozen
+uint32 words per rank) and compare. Equality on every rank ⇒ the audit is a
+bit-identical no-op (the untaken repair cond). The gathered matrix is
+identical on every rank, so the majority/reference-rank election and every
+branch decision below it are replicated — all ranks take the same branches
+and the repair collectives rendezvous.
+
+**Repair** (in-graph, atomic against params/opt/mem/telemetry):
+
+* elect the reference rank = lowest mesh index among the ranks whose
+  fingerprint matches the most others (majority vote; with one corrupted
+  rank out of W, the W-1 healthy ranks win);
+* broadcast the reference rank's replicated state to everyone via the
+  bit-exact :func:`~grace_tpu.comm.masked_broadcast` (axis_index-masked
+  psum in integer bit space — a float psum would flip ``-0.0 + 0.0``);
+* **zero the divergent rank's residuals** instead of broadcasting them:
+  residuals are per-rank data, so there is nothing consistent to broadcast,
+  and a residual on a corrupted rank is itself suspect. Zeroing is safe by
+  the error-feedback contract — the memory re-accumulates exactly the
+  compression error it would have tracked, costing at most a few steps of
+  feedback quality (see IMPLEMENTING.md, "Why repair zeroes residuals");
+* bump the replicated :class:`~grace_tpu.transform.AuditState` counters;
+* **escalate** if the same rank re-diverges within ``escalate_window``
+  steps of its last repair: a repeat offender suggests sticky corruption
+  (bad HBM, a wedged core), so the repair path arms the PR-1 dense escape
+  hatch — ``GraceState.fallback`` is set and a co-resident
+  ``GuardState.fallback_remaining`` is raised to ``escalate_steps``, giving
+  the existing guard countdown ownership of the dense window. Without a
+  guard in the chain the flag simply stays set (permanent dense fallback —
+  degraded but safe).
+
+Wiring: build the transform with ``grace_transform(consensus=True)`` (or
+``grace_from_params({"consensus": ...})``) to thread the
+:class:`~grace_tpu.transform.AuditState`, and pass the config to
+``make_train_step(consensus=ConsensusConfig(...))`` — the hook runs after
+``apply_updates`` inside the jitted shard_map step, where params, optimizer
+state, and the mesh axis are all in scope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from grace_tpu.comm import masked_broadcast
+from grace_tpu.core import DEFAULT_AXIS, axis_size
+from grace_tpu.telemetry.state import FIELD_INDEX, TelemetryState
+from grace_tpu.transform import AuditState, GraceState
+
+__all__ = ["ConsensusConfig", "normalize_consensus", "fingerprint_tree",
+           "consensus_step", "audit_report"]
+
+_UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+# Knuth multiplicative-hash constants for the position-weighted fold.
+_PRIME_POS = np.uint32(2654435761)
+_PRIME_LEAF = np.uint32(2246822519)
+_SALT = np.uint32(374761393)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusConfig:
+    """Static knobs of the consistency auditor (hashable, jit-safe).
+
+    ``audit_every`` — steps between audits (the ``lax.cond`` gate on
+    ``GraceState.count``). ``segments`` — fingerprint granularity: leaves
+    are folded into ``segments`` buckets, each contributing one float-fold
+    word and one bit-checksum word (vector length ``2 * segments``).
+    ``zero_residuals`` — zero the divergent rank's ``GraceState.mem`` on
+    repair (see module docstring; disable only for diagnosis).
+    ``escalate_window``/``escalate_steps`` — if the *same* rank re-diverges
+    within ``escalate_window`` steps of its last repair, arm the dense
+    escape hatch for ``escalate_steps`` steps (requires
+    ``grace_transform(escape=...)`` for the dense routing, and a
+    ``guard_transform`` in the chain for the countdown). Must be set
+    together; None disables escalation.
+    """
+
+    audit_every: int = 50
+    segments: int = 8
+    zero_residuals: bool = True
+    escalate_window: Optional[int] = None
+    escalate_steps: Optional[int] = None
+
+    def __post_init__(self):
+        if self.audit_every < 1:
+            raise ValueError(f"audit_every must be >= 1; "
+                             f"got {self.audit_every}")
+        if self.segments < 1:
+            raise ValueError(f"segments must be >= 1; got {self.segments}")
+        if (self.escalate_window is None) != (self.escalate_steps is None):
+            raise ValueError("escalate_window and escalate_steps must be "
+                             "set together")
+        if self.escalate_steps is not None and self.escalate_steps < 1:
+            raise ValueError(f"escalate_steps must be >= 1; "
+                             f"got {self.escalate_steps}")
+
+
+def normalize_consensus(consensus) -> Optional[ConsensusConfig]:
+    """Accept the ergonomic spellings of the consensus knob: None/False
+    (off), True (defaults), int (audit_every), dict (config kwargs), or a
+    ConsensusConfig — mirroring the telemetry knob."""
+    if consensus is None or consensus is False:
+        return None
+    if consensus is True:
+        return ConsensusConfig()
+    if isinstance(consensus, ConsensusConfig):
+        return consensus
+    if isinstance(consensus, int):
+        return ConsensusConfig(audit_every=consensus)
+    if isinstance(consensus, dict):
+        return ConsensusConfig(**consensus)
+    raise TypeError(f"consensus must be None/bool/int/dict/ConsensusConfig; "
+                    f"got {type(consensus).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# tree plumbing
+# ---------------------------------------------------------------------------
+
+def _is_grace(x) -> bool:
+    return isinstance(x, GraceState)
+
+
+def _grace_nodes(tree) -> list:
+    found: list = []
+
+    def walk(node):
+        if _is_grace(node):
+            found.append(node)
+        return node
+
+    jax.tree_util.tree_map(walk, tree, is_leaf=_is_grace)
+    return found
+
+
+def replicated_view(tree):
+    """``tree`` with the per-rank GraceState payloads (mem/comp/telem)
+    dropped: exactly the leaves that must be bit-identical across ranks —
+    params, downstream optimizer state, guard counters, and the replicated
+    GraceState scalars (count, rng_key, fallback, audit)."""
+
+    def strip(node):
+        if _is_grace(node):
+            return node._replace(mem=None, comp=None, telem=None)
+        return node
+
+    return jax.tree_util.tree_map(strip, tree, is_leaf=_is_grace)
+
+
+def _word_stream(x: jax.Array) -> jax.Array:
+    """Flatten any array to a 1-D uint32 word stream of its bit pattern."""
+    x = jnp.asarray(x)
+    if x.size == 0:
+        return jnp.zeros((0,), jnp.uint32)
+    if x.dtype == jnp.bool_:
+        return x.ravel().astype(jnp.uint32)
+    bits = lax.bitcast_convert_type(x, _UINT[x.dtype.itemsize]).ravel()
+    if x.dtype.itemsize == 8:
+        lo = (bits & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (bits >> np.uint64(32)).astype(jnp.uint32)
+        return jnp.concatenate([lo, hi])
+    return bits.astype(jnp.uint32)
+
+
+def fingerprint_tree(tree, segments: int = 8) -> jax.Array:
+    """Per-rank fingerprint of a pytree: a ``(2 * segments,)`` uint32 vector.
+
+    Leaf ``i`` folds into segment ``i % segments`` twice:
+
+    * **bit checksum** — the leaf's bit pattern as uint32 words, each word
+      multiplied by a position-and-leaf-salted odd weight and summed mod
+      2^32. Position weighting means swapped elements don't alias; leaf
+      salting means identical leaves at different tree positions don't
+      cancel. Catches any bit-level difference, including ``-0.0`` vs
+      ``+0.0`` and NaN-payload changes that value comparison cannot see.
+    * **float fold** — plain float32 value sum of inexact leaves, a
+      magnitude-sensitive second opinion; compared via its own bit pattern
+      (so a NaN-poisoned fold still compares deterministically).
+
+    Pure per-rank math — no collectives; deterministic for a given tree, so
+    ranks holding bit-identical state produce bit-identical fingerprints.
+    """
+    bitsum = jnp.zeros((segments,), jnp.uint32)
+    valsum = jnp.zeros((segments,), jnp.float32)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        leaf = jnp.asarray(leaf)
+        if leaf.size == 0:
+            continue
+        seg = i % segments
+        words = _word_stream(leaf)
+        # Python-int arithmetic, masked: numpy scalar * warns on wraparound.
+        salt = np.uint32((i * int(_PRIME_LEAF) + int(_SALT)) & 0xFFFFFFFF)
+        weights = (jnp.arange(words.size, dtype=jnp.uint32) * _PRIME_POS
+                   | np.uint32(1))
+        bitsum = bitsum.at[seg].add(jnp.sum((words ^ salt) * weights,
+                                            dtype=jnp.uint32))
+        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+            valsum = valsum.at[seg].add(
+                jnp.sum(leaf.astype(jnp.float32)))
+    return jnp.concatenate(
+        [bitsum, lax.bitcast_convert_type(valsum, jnp.uint32)])
+
+
+def _tree_nbytes(tree) -> int:
+    """Static logical byte count of every array leaf (trace-time Python)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        leaf = jnp.asarray(leaf)
+        total += int(np.prod(leaf.shape, dtype=np.int64)) * leaf.dtype.itemsize
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the audit + repair step
+# ---------------------------------------------------------------------------
+
+def consensus_step(tree, consensus, axis_name: str = DEFAULT_AXIS):
+    """Audit-and-repair hook over a full per-device train-state pytree.
+
+    Called inside the jitted shard_map step (``make_train_step(consensus=)``
+    does this after ``apply_updates``); ``tree`` is any pytree containing at
+    least one consensus-armed GraceState (params, model state, optimizer
+    state bundled together). Every ``audit_every`` steps — gated by
+    ``lax.cond`` on a replicated step counter (the guard's always-advancing
+    ``step`` when present, else ``GraceState.count``) so other steps pay
+    ~nothing — fingerprints the replicated state, compares across
+    ``axis_name``, and on divergence repairs in-graph (see module
+    docstring). Bit-identical to a no-op when replicas agree.
+    """
+    from grace_tpu.resilience.guard import GuardState
+
+    config = normalize_consensus(consensus)
+    if config is None:
+        return tree
+    graces = _grace_nodes(tree)
+    armed = [g for g in graces if g.audit is not None]
+    if not armed:
+        raise ValueError(
+            "consensus auditing is configured but the state carries no "
+            "AuditState — build the grace transform with consensus=... "
+            "(grace_from_params({'consensus': ...})) and re-init the "
+            "optimizer state, or restore a checkpoint written with a "
+            "consensus-armed transform.")
+    # Audit clock: the guard's step counter when a guard wraps the chain —
+    # it advances on EVERY step, including guard-skipped ones, so a fault
+    # that makes every step roll back (frozen GraceState.count) cannot
+    # starve the audit that would repair it. GraceState.count otherwise.
+    guards: list = []
+    jax.tree_util.tree_map(
+        lambda n: guards.append(n) if isinstance(n, GuardState) else n,
+        tree, is_leaf=lambda n: isinstance(n, GuardState))
+    clock = guards[0].step if guards else armed[0].count
+    due = jnp.equal(jnp.mod(clock, config.audit_every), 0)
+    return lax.cond(due,
+                    lambda t: _audit(t, config, axis_name),
+                    lambda t: t,
+                    tree)
+
+
+def _audit(tree, config: ConsensusConfig, axis_name: str):
+    w = axis_size(axis_name)                     # static at trace time
+    fp = fingerprint_tree(replicated_view(tree), config.segments)
+    fps = lax.all_gather(fp, axis_name, axis=0, tiled=False)   # (W, 2S)
+
+    # Pairwise agreement matrix; identical on every rank (fps is gathered),
+    # so the election and every branch below are replicated decisions.
+    eq = jnp.all(fps[:, None, :] == fps[None, :, :], axis=-1)  # (W, W)
+    matches = jnp.sum(eq, axis=1)                              # (W,)
+    best = jnp.max(matches)
+    ref = jnp.argmax(matches == best)        # lowest index among majority
+    any_div = best < w
+    # First rank disagreeing with the reference (replicated); -1 if none.
+    divergent_rank = jnp.where(any_div,
+                               jnp.argmax(~eq[ref]).astype(jnp.int32),
+                               jnp.asarray(-1, jnp.int32))
+    me = lax.axis_index(axis_name)
+    diverged_me = ~eq[me, ref]               # per-rank: am I the outlier?
+
+    count = _grace_nodes(tree)[0].count
+    repair_bytes = _tree_nbytes(replicated_view(tree))
+    fp_bytes = int(w) * 2 * config.segments * 4
+
+    def repair(t):
+        return _repair(t, ref, diverged_me, config, axis_name)
+
+    repaired = lax.cond(any_div, repair, lambda t: t, tree)
+    repaired = _advance_audit(repaired, config, count, any_div,
+                              divergent_rank)
+    extra = (jnp.asarray(float(fp_bytes), jnp.float32)
+             + jnp.where(any_div, jnp.asarray(float(repair_bytes),
+                                              jnp.float32), 0.0))
+    return _account_audit_bytes(repaired, count, extra)
+
+
+def _repair(tree, ref, diverged_me, config: ConsensusConfig,
+            axis_name: str):
+    """Broadcast the reference rank's replicated state bit-exactly; zero the
+    divergent rank's residuals. Per-rank telemetry rings and compressor
+    state pass through untouched (rings are observational; compressor state
+    is per-rank by contract, and e.g. PowerSGD's Q must stay a valid
+    iterate, which zeros are not — the residual zeroing alone restores the
+    error-feedback invariant)."""
+
+    def zero_if_diverged(m):
+        return jnp.where(diverged_me, jnp.zeros_like(m), m)
+
+    def fix(node):
+        if _is_grace(node):
+            mem = node.mem
+            if config.zero_residuals:
+                mem = jax.tree_util.tree_map(zero_if_diverged, mem)
+            return node._replace(
+                count=masked_broadcast(node.count, ref, axis_name),
+                rng_key=masked_broadcast(node.rng_key, ref, axis_name),
+                mem=mem,
+                fallback=masked_broadcast(node.fallback, ref, axis_name),
+                audit=jax.tree_util.tree_map(
+                    lambda a: masked_broadcast(a, ref, axis_name),
+                    node.audit))
+        return masked_broadcast(node, ref, axis_name)
+
+    return jax.tree_util.tree_map(fix, tree, is_leaf=_is_grace)
+
+
+def _advance_audit(tree, config: ConsensusConfig, count, any_div,
+                   divergent_rank):
+    """Bump the replicated AuditState bookkeeping and, when the same rank
+    re-diverges within the escalation window, arm the dense escape hatch."""
+    from grace_tpu.resilience.guard import GuardState
+
+    escalate = jnp.zeros((), jnp.bool_)
+    if config.escalate_window is not None:
+        prev = [g.audit for g in _grace_nodes(tree) if g.audit is not None][0]
+        same_rank = any_div & (divergent_rank == prev.last_divergent_rank)
+        within = (count - prev.last_repair_step) <= config.escalate_window
+        escalate = same_rank & within
+
+    one = jnp.ones((), jnp.int32)
+
+    def next_audit(a: AuditState) -> AuditState:
+        return AuditState(
+            audits=a.audits + one,
+            repairs=a.repairs + any_div.astype(jnp.int32),
+            escalations=a.escalations + escalate.astype(jnp.int32),
+            last_divergent_rank=jnp.where(any_div, divergent_rank,
+                                          a.last_divergent_rank),
+            last_repair_step=jnp.where(any_div, count.astype(jnp.int32),
+                                       a.last_repair_step))
+
+    def fix_grace(node):
+        if _is_grace(node):
+            audit = (next_audit(node.audit)
+                     if node.audit is not None else None)
+            fallback = node.fallback
+            if config.escalate_window is not None:
+                fallback = jnp.asarray(fallback, jnp.bool_) | escalate
+            return node._replace(audit=audit, fallback=fallback)
+        return node
+
+    tree = jax.tree_util.tree_map(fix_grace, tree, is_leaf=_is_grace)
+
+    if config.escalate_window is not None:
+        steps = jnp.asarray(config.escalate_steps, jnp.int32)
+
+        def fix_guard(node):
+            if isinstance(node, GuardState):
+                return node._replace(fallback_remaining=jnp.where(
+                    escalate,
+                    jnp.maximum(node.fallback_remaining, steps),
+                    node.fallback_remaining))
+            return node
+
+        tree = jax.tree_util.tree_map(
+            fix_guard, tree, is_leaf=lambda n: isinstance(n, GuardState))
+    return tree
+
+
+def _account_audit_bytes(tree, count, extra):
+    """Fold the audit's wire cost (fingerprint exchange + any repair
+    broadcast) into the telemetry row of the step that just ran, so the
+    reported effective bytes stay honest on audit steps. The row slot is
+    guarded by its step id — under the guard a rolled-back step leaves the
+    ring pointing at older data, which must not absorb the cost."""
+    wire_i = FIELD_INDEX["wire_bytes"]
+    audit_i = FIELD_INDEX["audit_bytes"]
+    row_step = (count - 1).astype(jnp.int32)
+
+    def fix(node):
+        if _is_grace(node) and isinstance(node.telem, TelemetryState):
+            t = node.telem
+            slot = jnp.mod(row_step, t.steps.shape[0])
+            add = jnp.where(t.steps[slot] == row_step, extra, 0.0)
+            rings = t.rings.at[slot, wire_i].add(add)
+            rings = rings.at[slot, audit_i].add(add)
+            return node._replace(telem=TelemetryState(rings=rings,
+                                                      steps=t.steps))
+        return node
+
+    return jax.tree_util.tree_map(fix, tree, is_leaf=_is_grace)
+
+
+# ---------------------------------------------------------------------------
+# host-side reporting
+# ---------------------------------------------------------------------------
+
+def audit_report(state: Any) -> dict:
+    """Host-side summary of the consensus auditor in any state pytree.
+
+    Mirrors :func:`grace_tpu.utils.metrics.guard_report`: walks the tree
+    for the first armed :class:`~grace_tpu.transform.AuditState` and
+    returns its counters in one device-to-host transfer::
+
+        {"audits", "repairs", "escalations",
+         "last_divergent_rank", "last_repair_step"}
+
+    Empty dict when no consensus-armed GraceState is present.
+    """
+    audits = [g.audit for g in _grace_nodes(state) if g.audit is not None]
+    if not audits:
+        return {}
+    a = audits[0]
+    au, rp, es, dr, rs = (np.asarray(v).reshape(-1)[0] for v in
+                          jax.device_get([a.audits, a.repairs,
+                                          a.escalations,
+                                          a.last_divergent_rank,
+                                          a.last_repair_step]))
+    return {"audits": int(au), "repairs": int(rp), "escalations": int(es),
+            "last_divergent_rank": int(dr), "last_repair_step": int(rs)}

@@ -27,7 +27,7 @@ import jax
 __all__ = ["trace_stage", "STAGE_COMPENSATE", "STAGE_COMPRESS",
            "STAGE_EXCHANGE", "STAGE_DECOMPRESS", "STAGE_MEMORY_UPDATE",
            "STAGE_FWD_BWD", "STAGE_OPTIMIZER", "STAGE_APPLY",
-           "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE"]
+           "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS"]
 
 # Canonical stage names — one vocabulary for the profiler, the report tool,
 # and the docs. Keep in sync with README "Observability".
@@ -41,6 +41,7 @@ STAGE_OPTIMIZER = "grace/optimizer"
 STAGE_APPLY = "grace/apply_updates"
 STAGE_TELEMETRY = "grace/telemetry"
 STAGE_DENSE_ESCAPE = "grace/dense_escape"
+STAGE_CONSENSUS = "grace/consensus"
 
 
 @contextlib.contextmanager

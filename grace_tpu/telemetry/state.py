@@ -59,6 +59,10 @@ FIELDS = (
     ("wire_bytes", "first"),        # EFFECTIVE payload bytes this step
     ("dense_bytes", "first"),       # dense cost of the same gradients
     ("fallback", "max"),            # 1.0 while the dense escape hatch is live
+    ("audit_bytes", "first"),       # consensus-audit wire cost this step:
+                                    # fingerprint exchange + any repair
+                                    # broadcast (also folded into wire_bytes
+                                    # so effective bytes stay honest)
 )
 
 FIELD_INDEX = {name: i for i, (name, _) in enumerate(FIELDS)}

@@ -39,8 +39,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from grace_tpu.core import DEFAULT_AXIS
 from grace_tpu.parallel import replicated, shard_map
-from grace_tpu.telemetry.scopes import (STAGE_APPLY, STAGE_FWD_BWD,
-                                        STAGE_OPTIMIZER, trace_stage)
+from grace_tpu.telemetry.scopes import (STAGE_APPLY, STAGE_CONSENSUS,
+                                        STAGE_FWD_BWD, STAGE_OPTIMIZER,
+                                        trace_stage)
 from grace_tpu.transform import (add_world_axis, partition_specs,
                                  strip_world_axis)
 
@@ -92,7 +93,8 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
                     mesh: Mesh,
                     axis_name: str = DEFAULT_AXIS,
                     donate: bool = True,
-                    remat: bool = False):
+                    remat: bool = False,
+                    consensus=None):
     """Build ``step(state, batch) -> (state, loss)``.
 
     ``loss_fn(params, batch)`` must return the mean loss over its *local*
@@ -107,9 +109,17 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
     recomputed during backward instead of held in HBM — the standard
     FLOPs-for-memory trade when activation footprint (not the gradient
     exchange this library compresses) is the limiting factor.
+
+    ``consensus`` (None | True | int ``audit_every`` | dict |
+    ``ConsensusConfig``): run the cross-rank consistency audit + self-heal
+    (:mod:`grace_tpu.resilience.consensus`) after ``apply_updates``, inside
+    the same jitted shard_map step. Requires the grace transform to have
+    been built with ``consensus=...`` so ``GraceState`` carries the
+    ``AuditState`` (clear in-graph error otherwise).
     """
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
+    consensus = _normalize_consensus(consensus)
 
     def device_step(state: TrainState, batch):
         opt_state = strip_world_axis(state.opt_state)
@@ -123,10 +133,28 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
                                                   state.params)
         with trace_stage(STAGE_APPLY):
             params = optax.apply_updates(state.params, updates)
+        if consensus is not None:
+            with trace_stage(STAGE_CONSENSUS):
+                params, opt_state = _consensus_step(
+                    (params, opt_state), consensus, axis_name)
         loss = lax.pmean(loss, axis_name)
         return TrainState(params, add_world_axis(opt_state)), loss
 
     return _lazy_sharded_step(device_step, mesh, axis_name, donate)
+
+
+def _normalize_consensus(consensus):
+    """Lazy import: resilience.consensus imports transform (as this module
+    does), so the dependency must stay function-local to avoid a cycle."""
+    if consensus is None or consensus is False:
+        return None
+    from grace_tpu.resilience.consensus import normalize_consensus
+    return normalize_consensus(consensus)
+
+
+def _consensus_step(tree, config, axis_name):
+    from grace_tpu.resilience.consensus import consensus_step
+    return consensus_step(tree, config, axis_name)
 
 
 def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
@@ -136,7 +164,8 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
                              axis_name: str = DEFAULT_AXIS,
                              donate: bool = True,
                              sync_model_state: bool = True,
-                             remat: bool = False):
+                             remat: bool = False,
+                             consensus=None):
     """Like :func:`make_train_step` for models with non-param state (BN stats).
 
     ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
@@ -144,10 +173,13 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
     statistics stay replicated (the reference's DDP examples leave BN stats
     rank-local and implicitly use rank 0's at save time; replication is the
     deterministic version of the same thing, and the stats are tiny).
-    ``remat`` as in :func:`make_train_step`.
+    ``remat``/``consensus`` as in :func:`make_train_step` — the audit
+    fingerprints model state too (it is replicated), so BN-stat divergence
+    is detected and repaired alongside params.
     """
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
+    consensus = _normalize_consensus(consensus)
 
     def device_step(state: StatefulTrainState, batch):
         opt_state = strip_world_axis(state.opt_state)
@@ -163,6 +195,10 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
                                                   state.params)
         with trace_stage(STAGE_APPLY):
             params = optax.apply_updates(state.params, updates)
+        if consensus is not None:
+            with trace_stage(STAGE_CONSENSUS):
+                params, mstate, opt_state = _consensus_step(
+                    (params, mstate, opt_state), consensus, axis_name)
         loss = lax.pmean(loss, axis_name)
         return (StatefulTrainState(params, mstate, add_world_axis(opt_state)),
                 loss)

@@ -16,12 +16,15 @@ parameter's compensate→compress→update→exchange is traced into ONE XLA
 program — the reference's per-parameter Python loop over world_size × n_params
 decompressions (SURVEY.md §3.1 hot loop) disappears into the compiler.
 
-State layout: ``GraceState(count, rng_key, mem, comp, fallback, telem)``
+State layout: ``GraceState(count, rng_key, mem, comp, fallback, telem,
+audit)``
 where ``mem``/``comp`` are tuples aligned with the flattened gradient leaves,
 ``fallback`` is the replicated resilience health flag (see
-``grace_transform(escape=...)``), and ``telem`` is the optional on-device
+``grace_transform(escape=...)``), ``telem`` is the optional on-device
 telemetry ring (``grace_transform(telemetry=...)``; None when telemetry is
-off, so the default state is unchanged). The rng key is
+off, so the default state is unchanged), and ``audit`` is the optional
+replicated consensus-audit bookkeeping (``grace_transform(consensus=...)``;
+see :mod:`grace_tpu.resilience.consensus`). The rng key is
 replicated across ranks, so per-(step, leaf) keys derived via ``fold_in`` are
 rank-identical — the explicit contract RandomK/PowerSGD rely on (the
 reference relied on global-seed side effects, grace_dl/dist/compressor/
@@ -56,6 +59,30 @@ from grace_tpu.telemetry.state import (TelemetryConfig, telemetry_init,
                                        telemetry_record)
 
 
+class AuditState(NamedTuple):
+    """Replicated bookkeeping of the cross-rank consistency auditor.
+
+    Threaded through ``GraceState.audit`` when ``grace_transform`` is built
+    with ``consensus=...``; read and advanced in-graph by
+    :func:`grace_tpu.resilience.consensus.consensus_step`. Every field is
+    an int32 scalar, replicated across ranks (derived from all-gathered
+    fingerprints, so all ranks compute identical values) — and is itself
+    part of the audited/repaired replicated state.
+    """
+
+    audits: jax.Array                 # audits performed
+    repairs: jax.Array                # repair events (any-rank divergence)
+    escalations: jax.Array            # repeat-offender dense-fallback trips
+    last_divergent_rank: jax.Array    # mesh index of last divergent rank, -1
+    last_repair_step: jax.Array       # GraceState.count at last repair, -1
+
+
+def audit_init() -> AuditState:
+    zero = jnp.zeros((), jnp.int32)
+    return AuditState(audits=zero, repairs=zero, escalations=zero,
+                      last_divergent_rank=zero - 1, last_repair_step=zero - 1)
+
+
 class GraceState(NamedTuple):
     count: jax.Array          # step counter (replicated)
     rng_key: jax.Array        # replicated base key, stored as raw key data
@@ -71,6 +98,13 @@ class GraceState(NamedTuple):
     # telemetry=..., else None (an empty pytree node — invisible to
     # checkpointing, sharding, and the guard).
     telem: Any = None
+    # Consensus-audit bookkeeping (replicated, like count/fallback): an
+    # AuditState when grace_transform was built with consensus=..., else
+    # None (an empty pytree node). grace_transform only *threads* it; the
+    # audit itself runs at the train-step level (make_train_step(consensus=))
+    # where params and the whole optimizer state are in scope — see
+    # grace_tpu.resilience.consensus.
+    audit: Any = None
 
 
 def _is_grace(x) -> bool:
@@ -131,7 +165,8 @@ def partition_specs(tree, axis_name: str):
                 fallback=jax.tree_util.tree_map(lambda _: P(),
                                                 node.fallback),
                 telem=jax.tree_util.tree_map(lambda _: P(axis_name),
-                                             node.telem))
+                                             node.telem),
+                audit=jax.tree_util.tree_map(lambda _: P(), node.audit))
         return jax.tree_util.tree_map(lambda _: P(), node)
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -211,7 +246,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     communicator: Communicator, seed: int = 0,
                     fusion: Optional[int | str] = None,
                     escape: Optional[Compressor] = None,
-                    telemetry=None
+                    telemetry=None,
+                    consensus=None
                     ) -> optax.GradientTransformation:
     """Build the compressed-exchange transformation.
 
@@ -278,8 +314,19 @@ def grace_transform(compressor: Compressor, memory: Memory,
     the duplicate when no error-feedback memory rewrites the input); set
     ``TelemetryConfig(compression_error=False)`` to make telemetry
     near-free.
+
+    ``consensus`` (None | True | int ``audit_every`` | dict |
+    ``ConsensusConfig``): arm the cross-rank consistency auditor
+    (:mod:`grace_tpu.resilience.consensus`) by threading an
+    :class:`AuditState` through ``GraceState.audit``. The transform only
+    carries the state — the audit hook itself runs at the train-step level
+    (``make_train_step(consensus=...)``), where params and the full
+    optimizer state are in scope for fingerprinting and repair. Any truthy
+    value arms the state; the schedule/repair knobs are read from the
+    config handed to the train step.
     """
     telemetry = _normalize_telemetry(telemetry)
+    consensus_armed = consensus is not None and consensus is not False
     if escape is not None and not (getattr(escape, "summable_payload", False)
                                    and escape.average):
         raise ValueError(
@@ -331,7 +378,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                           mem=mem, comp=comp,
                           fallback=jnp.zeros((), jnp.bool_),
                           telem=(telemetry_init(telemetry)
-                                 if telemetry is not None else None))
+                                 if telemetry is not None else None),
+                          audit=audit_init() if consensus_armed else None)
 
     def _run_compressed(operand):
         leaves, mem, comp, step_key = operand
@@ -545,6 +593,9 @@ def grace_transform(compressor: Compressor, memory: Memory,
             "wire_bytes": eff,
             "dense_bytes": jnp.asarray(float(dense_b), jnp.float32),
             "fallback": jnp.asarray(state.fallback, jnp.float32),
+            # Filled in after the fact by consensus_step on audit steps —
+            # the audit runs post-apply, after this row is written.
+            "audit_bytes": jnp.zeros((), jnp.float32),
         })
 
     def update(updates, state: GraceState, params=None):
@@ -570,7 +621,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                                         step_key)
         new_state = GraceState(count=state.count + 1, rng_key=state.rng_key,
                                mem=new_mem, comp=new_comp,
-                               fallback=state.fallback, telem=telem)
+                               fallback=state.fallback, telem=telem,
+                               audit=state.audit)
         return jax.tree_util.tree_unflatten(treedef, outs), new_state
 
     return optax.GradientTransformation(init, update)

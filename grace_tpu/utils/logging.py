@@ -23,9 +23,9 @@ from typing import Callable, Mapping, Optional, Sequence, TextIO
 
 import jax
 
-__all__ = ["Timer", "TableLogger", "TSVLogger", "GuardMonitor", "localtime",
-           "rank_zero_only", "rank_zero_print", "run_provenance",
-           "git_commit"]
+__all__ = ["Timer", "TableLogger", "TSVLogger", "GuardMonitor",
+           "ConsensusMonitor", "localtime", "rank_zero_only",
+           "rank_zero_print", "run_provenance", "git_commit"]
 
 
 def localtime() -> str:
@@ -202,6 +202,57 @@ class GuardMonitor:
         if prev["fallback_active"] and not report["fallback_active"]:
             self._print(f"[guard] step {step}: compression re-armed")
             self._event("guard_rearmed", step, report)
+
+
+class ConsensusMonitor:
+    """Emit consensus-auditor *transitions*: repairs and escalations.
+
+    The :class:`GuardMonitor` twin for the cross-rank consistency auditor
+    (:mod:`grace_tpu.resilience.consensus`). Feed it the per-step dict from
+    :func:`grace_tpu.resilience.consensus.audit_report`; it prints (rank-0
+    only) and — via ``sink`` — emits a structured record only when a
+    counter moved, so a healthy run stays silent::
+
+        mon = ConsensusMonitor(sink=jsonl_sink)
+        for i, batch in enumerate(batches):
+            state, loss = step(state, batch)
+            mon.update(i, audit_report(state))
+
+    Sink records: ``{"event": "consensus_repair" |
+    "consensus_escalation", "step": …, **report}`` — they land in the same
+    JSONL stream as the telemetry rows and guard events, so repairs line
+    up against the per-step metrics (including the ``audit_bytes`` the
+    repair itself cost).
+    """
+
+    def __init__(self, printer: Optional[Callable[..., None]] = None,
+                 sink=None):
+        self._print = printer or rank_zero_print
+        self._sink = sink
+        self._last: Optional[dict] = None
+
+    def _event(self, name: str, step: int,
+               report: Mapping[str, object]) -> None:
+        if self._sink is not None:
+            self._sink.write({"event": name, "step": step, **report})
+
+    def update(self, step: int, report: Mapping[str, object]) -> None:
+        if not report:
+            return
+        prev, self._last = self._last, dict(report)
+        if prev is None:
+            return
+        if report["repairs"] > prev["repairs"]:
+            self._print(f"[consensus] step {step}: replica divergence on "
+                        f"rank {report['last_divergent_rank']} repaired "
+                        f"(total repairs={report['repairs']})")
+            self._event("consensus_repair", step, report)
+        if report["escalations"] > prev["escalations"]:
+            self._print(f"[consensus] step {step}: rank "
+                        f"{report['last_divergent_rank']} re-diverged — "
+                        f"escalating to dense fallback "
+                        f"(total escalations={report['escalations']})")
+            self._event("consensus_escalation", step, report)
 
 
 def git_commit() -> Optional[str]:

@@ -36,7 +36,8 @@ TOPK_TELEM = {"compressor": "topk", "compress_ratio": 0.3,
               "memory": "residual", "communicator": "allgather"}
 
 REQUIRED = ("grad_norm", "update_norm", "residual_norm", "residual_max",
-            "compression_error", "wire_bytes", "dense_bytes", "fallback")
+            "compression_error", "wire_bytes", "dense_bytes", "fallback",
+            "audit_bytes")
 
 
 def _problem(seed=0):

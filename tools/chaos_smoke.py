@@ -14,16 +14,32 @@ stamped JSONL artifact at --telemetry-out, with guard transitions emitted
 into the same stream by ``GuardMonitor(sink=...)``. Render it with
 ``python tools/telemetry_report.py <artifact>``.
 
+SDC scenario (ISSUE 3): ``--sdc`` runs the *full* chaos matrix — the NaN
+injection above PLUS single-rank silent data corruption: ``ChaosParams``
+flips one bit of one param element in exactly one device's replica at
+``--sdc-steps``, a fault the guard is structurally blind to (finite values,
+rank-identical updates). The consensus auditor
+(``grace_tpu.resilience.consensus``, armed via ``consensus=``/
+``make_train_step(consensus=...)``) must detect and repair it within one
+audit window; repairs/escalations are emitted as ``consensus_repair`` /
+``consensus_escalation`` events into the same JSONL artifact as the
+telemetry rows and guard events (``ConsensusMonitor``), so the audit trail
+is a CI artifact.
+
 Exit status (for CI):
-  0  final loss is finite AND the guard tripped at least once
-  1  final loss is non-finite (the guard failed to contain the faults), or
-     the guard never tripped (injection is not reaching the pipeline — the
-     smoke itself is broken)
+  0  final loss is finite AND the guard tripped at least once AND (with
+     --sdc) every injected corruption was repaired and replicas end
+     bit-identical
+  1  final loss is non-finite (the guard failed to contain the faults), the
+     guard never tripped (injection is not reaching the pipeline — the
+     smoke itself is broken), or --sdc corruption went undetected /
+     replicas end diverged
 
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py            # defaults
     python tools/chaos_smoke.py --steps 200 --nan-prob 0.01
+    python tools/chaos_smoke.py --sdc                        # + param SDC
 """
 
 from __future__ import annotations
@@ -54,6 +70,16 @@ def main(argv=None) -> int:
                     help="JSONL telemetry artifact path ('' disables)")
     ap.add_argument("--telemetry-every", type=int, default=25,
                     help="steps per telemetry flush (one device_get each)")
+    ap.add_argument("--sdc", action="store_true",
+                    help="also inject single-rank param SDC (ChaosParams) "
+                         "and require the consensus auditor to repair it")
+    ap.add_argument("--sdc-rank", type=int, default=5,
+                    help="mesh index whose param replica gets the bitflips")
+    ap.add_argument("--sdc-steps", default="",
+                    help="comma-separated injection steps (default: two "
+                         "hits at 1/3 and 2/3 of --steps)")
+    ap.add_argument("--audit-every", type=int, default=20,
+                    help="consensus audit interval (with --sdc)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -79,10 +105,13 @@ def main(argv=None) -> int:
     from grace_tpu import grace_from_params
     from grace_tpu.models import lenet
     from grace_tpu.parallel import data_parallel_mesh
-    from grace_tpu.resilience import ChaosCommunicator, guarded_chain
+    from grace_tpu.resilience import (ChaosCommunicator, ChaosParams,
+                                      ConsensusConfig, audit_report,
+                                      guarded_chain)
     from grace_tpu.telemetry import JSONLSink, TelemetryReader
     from grace_tpu.train import init_train_state, make_train_step
-    from grace_tpu.utils.logging import GuardMonitor, run_provenance
+    from grace_tpu.utils.logging import (ConsensusMonitor, GuardMonitor,
+                                         run_provenance)
     from grace_tpu.utils.metrics import guard_report
 
     mesh = data_parallel_mesh()
@@ -99,10 +128,23 @@ def main(argv=None) -> int:
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
 
+    consensus = None
+    sdc = None
+    if args.sdc:
+        consensus = ConsensusConfig(
+            audit_every=args.audit_every,
+            escalate_window=4 * args.audit_every,
+            escalate_steps=args.fallback_steps)
+        sdc_steps = (tuple(int(s) for s in args.sdc_steps.split(","))
+                     if args.sdc_steps
+                     else (args.steps // 3, 2 * args.steps // 3))
+        sdc = ChaosParams(rank=args.sdc_rank, at_steps=sdc_steps,
+                          seed=args.seed + 2)
     grc = grace_from_params({"compressor": "topk", "compress_ratio": 0.3,
                              "memory": "residual",
                              "communicator": "allgather",
                              "escape": "fp16",
+                             "consensus": consensus,
                              # ring sized to the flush window so a healthy
                              # run never wraps between flushes
                              "telemetry": max(2 * args.telemetry_every, 16)})
@@ -115,7 +157,8 @@ def main(argv=None) -> int:
 
     params, _ = lenet.init(jax.random.key(args.seed))
     state = init_train_state(params, tx, mesh)
-    step = make_train_step(loss_fn, tx, mesh, donate=False)
+    step = make_train_step(loss_fn, tx, mesh, donate=False,
+                           consensus=consensus)
 
     sink = None
     reader = None
@@ -129,14 +172,19 @@ def main(argv=None) -> int:
             fallback_steps=args.fallback_steps))
         reader = TelemetryReader(sink, every=args.telemetry_every)
     monitor = GuardMonitor(sink=sink)
+    consensus_mon = ConsensusMonitor(sink=sink)
     t0 = time.perf_counter()
     loss = float("nan")
     for i in range(args.steps):
+        if sdc is not None:
+            state = sdc(state, i)
         lo = (i * batch) % len(images)
         b = (jnp.asarray(images[lo:lo + batch]),
              jnp.asarray(labels[lo:lo + batch]))
         state, loss = step(state, b)
         monitor.update(i, guard_report(state))
+        if sdc is not None:
+            consensus_mon.update(i, audit_report(state))
         if reader is not None:
             reader.update(i, state)
     loss = float(loss)
@@ -161,6 +209,25 @@ def main(argv=None) -> int:
         print("[chaos_smoke] FAIL: guard never tripped — injection is not "
               "reaching the pipeline", file=sys.stderr)
         return 1
+    if sdc is not None:
+        arep = audit_report(state)
+        diverged = max(
+            len({np.asarray(s.data).tobytes()
+                 for s in leaf.addressable_shards})
+            for leaf in jax.tree_util.tree_leaves(state.params))
+        print(f"[chaos_smoke] sdc: injected {len(sdc.injections)} | "
+              f"audits {arep['audits']} | repairs {arep['repairs']} | "
+              f"escalations {arep['escalations']} | "
+              f"replica_variants {diverged}")
+        if arep["repairs"] < len(sdc.injections):
+            print("[chaos_smoke] FAIL: consensus auditor repaired "
+                  f"{arep['repairs']} of {len(sdc.injections)} injected "
+                  "corruptions", file=sys.stderr)
+            return 1
+        if diverged > 1:
+            print("[chaos_smoke] FAIL: param replicas still diverged after "
+                  "the final audit window", file=sys.stderr)
+            return 1
     print("[chaos_smoke] OK")
     return 0
 

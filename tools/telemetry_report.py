@@ -27,7 +27,8 @@ from typing import List, Optional
 # Metric columns in display order; anything else numeric found in records
 # is appended after these.
 PREFERRED = ["grad_norm", "update_norm", "residual_norm", "residual_max",
-             "compression_error", "wire_bytes", "dense_bytes", "fallback"]
+             "compression_error", "wire_bytes", "dense_bytes", "fallback",
+             "audit_bytes"]
 
 
 def load(path: str):
