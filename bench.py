@@ -49,7 +49,10 @@ HEADLINE = [
     # backend issues one collective per tensor (SURVEY.md §3.3), so the
     # per-tensor pair is protocol-faithful AND measured fastest: the
     # round-5 on-chip A/B at bs=256 (2026-08-01, same session) put
-    # per-leaf Top-K at 2263.9 img/s = 0.9885x dense (spread 0.25%) vs
+    # per-leaf Top-K at 0.9895x dense (HEADLINE figure = the stamped
+    # evidence-table ratio, BENCH_r05/README; per-row ratios use
+    # interleaved dense brackets, so the raw row quotient differs in the
+    # 4th digit) vs
     # 0.9346x for the fused-flat pair — the whole-model fusion buffer
     # (concat + one monolithic pipeline), not the selection, carries most
     # of the fused overhead. The fused rows stay in bench_all (fusion is
@@ -226,23 +229,12 @@ def recv_bytes_model(comm, vote: bool, payload_b: int, n_elems: int,
     """Received bytes per rank per step at world size ``w`` — the
     communicator-aware wire number (payload bytes alone are communicator-
     blind and cannot show e.g. twoshot's O(k) vs allgather's O(W·k)).
-    Ring model for the reduce-style collectives. ``comm`` is the
-    communicator instance; shared by the live-mesh measurement and the
-    multi-chip projection so the two can never disagree."""
-    from grace_tpu.comm import (Allgather, Allreduce, SignAllreduce,
-                                TwoShotAllreduce)
-    if isinstance(comm, TwoShotAllreduce):
-        # stage-1 all_to_all + stage-2 all_gather, each ~payload_b·(W-1)/W
-        return 2 * payload_b * (w - 1) // max(1, w)
-    if isinstance(comm, SignAllreduce) or (isinstance(comm, Allreduce)
-                                           and vote):
-        # psum of dense ±1 votes in bf16 (2 bytes), ring: 2·(W-1)/W·n·2
-        return 2 * 2 * n_elems * (w - 1) // max(1, w)
-    if isinstance(comm, Allreduce):
-        return 2 * payload_b * (w - 1) // max(1, w)
-    if isinstance(comm, Allgather):   # Broadcast subclasses Allgather
-        return payload_b * (w - 1)
-    return 0                          # Identity
+    Delegates to ``Communicator.recv_wire_bytes`` — ONE model shared by the
+    live-mesh measurement, the multi-chip projection, and the in-graph
+    telemetry ring's wire_bytes field, so the three can never disagree.
+    (Formulas: allgather (W-1)·payload; allreduce/twoshot/ring ride ring
+    schedules at ~2·payload·(W-1)/W; vote psums move dense bf16 ±1s.)"""
+    return comm.recv_wire_bytes(payload_b, n_elems, w, vote=vote)
 
 
 def project_multichip(step_s: float, dense_step_s: float, grace,

@@ -86,13 +86,6 @@ CONFIGS = [
                 "topk_algorithm": "chunk", "memory": "residual",
                 "memory_dtype": "bfloat16",
                 "communicator": "allgather", "fusion": "flat"}},
-    # qsgd vs qsgd_pallas: THE evidence gate for flipping QSGD's
-    # use_pallas default (VERDICT r3 item 5, two rounds dark).
-    # use_pallas pinned False: this row is the STAGED side of the
-    # qsgd-vs-qsgd_pallas A/B. (The round-5 A/B measured the kernel 42%
-    # faster, so 'auto' — the factory default — now resolves kernel-on
-    # for TPU; leaving this unpinned would make both rows measure the
-    # kernel and erase the ablation.)
     # Fused dense at the headline batch: with the round-5 headline moving
     # to per-leaf (see bench.HEADLINE), this row keeps the strict
     # fused-vs-fused pairing measurable against topk1pct_bs256 above
@@ -100,6 +93,33 @@ CONFIGS = [
     {"name": "none_flat_bs256", "per_device_bs": 256,
      "params": {"compressor": "none", "memory": "none",
                 "communicator": "allreduce", "fusion": "flat"}},
+    # Ring all-reduce (ISSUE 4): hop-pipelined reduce-scatter/all-gather
+    # that keeps the payload compressed on every hop — recv ~2·k·(W-1)/W,
+    # flat in W like two-shot, but aggregation is spread around the ring
+    # and phase 2 ships the reduced shards still in wire format. The bs=256
+    # row pairs with topk1pct_bs256/topk1pct_twoshot_bs256 above for the
+    # three-way allgather/twoshot/ring comparison at the amortizing batch.
+    {"name": "topk1pct_ring_bs256", "per_device_bs": 256,
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "communicator": "ring", "fusion": "flat"}},
+    # QSGD on the ring exercises the per-hop requantization path proper
+    # (decompress → accumulate → requantize each hop; topk re-selects).
+    # use_pallas pinned False to match the staged qsgd row below —
+    # communicator is the only variable between the pair.
+    {"name": "qsgd_ring", "params": {"compressor": "qsgd",
+                                     "quantum_num": 64,
+                                     "use_pallas": False,
+                                     "memory": "none",
+                                     "communicator": "ring",
+                                     "fusion": "flat"}},
+    # qsgd vs qsgd_pallas: THE evidence gate for flipping QSGD's
+    # use_pallas default (VERDICT r3 item 5, two rounds dark).
+    # use_pallas pinned False: this row is the STAGED side of the
+    # qsgd-vs-qsgd_pallas A/B. (The round-5 A/B measured the kernel 42%
+    # faster, so 'auto' — the factory default — now resolves kernel-on
+    # for TPU; leaving this unpinned would make both rows measure the
+    # kernel and erase the ablation.)
     {"name": "qsgd",       "params": {"compressor": "qsgd",
                                       "quantum_num": 64,
                                       "use_pallas": False,

@@ -58,7 +58,7 @@ def add_grace_args(parser: argparse.ArgumentParser) -> None:
                    help="none|residual|efsignsgd|dgc|powersgd")
     g.add_argument("--communicator", default="allgather",
                    help="allreduce|allgather|broadcast|sign_allreduce|"
-                        "twoshot|identity")
+                        "twoshot|ring|identity")
     g.add_argument("--compress-ratio", type=float, default=0.01)
     g.add_argument("--quantum-num", type=int, default=64)
     g.add_argument("--threshold", type=float, default=0.01)

@@ -28,10 +28,10 @@ from jax import lax
 from grace_tpu.core import (Communicator, Compressor, Ctx, Payload,
                             axis_size)
 from grace_tpu.telemetry.scopes import (STAGE_DECOMPRESS, STAGE_EXCHANGE,
-                                        trace_stage)
+                                        STAGE_RING_HOP, trace_stage)
 
 __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
-           "SignAllreduce", "TwoShotAllreduce",
+           "SignAllreduce", "TwoShotAllreduce", "RingAllreduce",
            "masked_broadcast", "masked_broadcast_tree"]
 
 
@@ -137,6 +137,13 @@ class Allreduce(Communicator):
 
     vote_dtype: str = "bfloat16"
 
+    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
+                        vote: bool = False) -> int:
+        if vote:
+            # psum of dense ±1 votes in bf16 (2 bytes), ring: 2·(W-1)/W·n·2
+            return 2 * 2 * n_elems * (world - 1) // max(1, world)
+        return 2 * payload_nbytes * (world - 1) // max(1, world)
+
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:
         if getattr(compressor, "vote_aggregate", False):
@@ -237,6 +244,10 @@ class SignAllreduce(Communicator):
 
     vote_dtype: str = "bfloat16"
 
+    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
+                        vote: bool = False) -> int:
+        return 2 * 2 * n_elems * (world - 1) // max(1, world)
+
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:
         if not getattr(compressor, "vote_aggregate", False):
@@ -329,6 +340,48 @@ class _ChunkedView:
         return flat[:n].reshape(shape).astype(dtype)
 
 
+def _shard_compress(compressor: Compressor, chunks: jax.Array,
+                    rng: jax.Array, comm_name: str):
+    """Stage-1 shard encode shared by the shard-parallel communicators
+    (TwoShotAllreduce, RingAllreduce): probe one shard to pin the
+    (shard-uniform) static ctx structure, then vmap ``compress`` over the
+    ``(w, m)`` shard stack under shard-folded shared keys. Validates the
+    shared soundness conditions — a wire payload must exist to shard, and
+    ctx arrays must be data-free so every rank's locally derived ctx for
+    shard ``c`` equals the one the sender compressed with (the condition
+    that lets ranks decode each other's shard payloads without shipping
+    ctx). Returns ``(payloads, ctx_arrays, treedef, static)`` with payloads
+    and ctx arrays stacked along the shard axis."""
+    w = chunks.shape[0]
+    probe_payload, probe_ctx, _ = compressor.compress(
+        chunks[0], None, jax.random.fold_in(rng, 0))
+    if not probe_payload:
+        raise TypeError(
+            f"{comm_name} needs a wire payload to scatter; "
+            f"{type(compressor).__name__} communicates inside compress "
+            "— use Allreduce instead.")
+    if not ctx_is_data_free(compressor, chunks.shape[1], chunks.dtype):
+        raise TypeError(
+            f"{comm_name} requires a data-free ctx; "
+            f"{type(compressor).__name__}.compress puts data-derived "
+            "arrays in ctx, and ranks decode each other's shard payloads "
+            "with locally derived ctx (identical across ranks only when "
+            "ctx arrays are functions of shape and the shared rng alone) "
+            "— other ranks' shards would decode against the wrong values. "
+            "Keep data-derived arrays in the payload (they travel on the "
+            "wire) or use Allgather/Allreduce.")
+    treedef, static, _ = _split_ctx(probe_ctx)
+
+    def comp_one(chunk, c):
+        payload, ctx, _ = compressor.compress(
+            chunk, None, jax.random.fold_in(rng, c))
+        _, _, arrays = _split_ctx(ctx)
+        return tuple(payload), tuple(arrays)
+
+    payloads, ctx_arrays = jax.vmap(comp_one)(chunks, jnp.arange(w))
+    return payloads, ctx_arrays, treedef, static
+
+
 @dataclasses.dataclass(frozen=True)
 class TwoShotAllreduce(Communicator):
     """Scatter–reduce–(re)compress all-reduce: O(k) wire per rank.
@@ -376,6 +429,12 @@ class TwoShotAllreduce(Communicator):
     """
 
     stage2_feedback: bool = False
+    shard_parallel = True
+
+    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
+                        vote: bool = False) -> int:
+        # stage-1 all_to_all + stage-2 all_gather, each ~payload_b·(W-1)/W
+        return 2 * payload_nbytes * (world - 1) // max(1, world)
 
     def step(self, x: jax.Array, mem_state, comp_state,
              memory, compressor: Compressor, rng: jax.Array):
@@ -385,43 +444,21 @@ class TwoShotAllreduce(Communicator):
                 f"{type(compressor).__name__} carries cross-step state "
                 "(init_state != None) that has no per-chunk meaning — use "
                 "Allgather/Allreduce instead.")
-        w = axis_size(self.axis_name)               # static at trace time
         shape, dtype = x.shape, x.dtype
         compensated, mem_state = memory.compensate(x, mem_state)
         flat = compensated.reshape(-1)
         n = flat.size
-        chunks = jnp.pad(flat, (0, (-n) % w)).reshape(w, -1)
+        w, _, pad = self.shard_spec(n)              # static at trace time
+        chunks = jnp.pad(flat, (0, pad)).reshape(w, -1)
 
-        # Stage 1: per-chunk compress under a chunk-folded shared key. One
-        # probe call pins the (chunk-uniform) static ctx structure; vmap
-        # carries the array leaves.
-        probe_payload, probe_ctx, _ = compressor.compress(
-            chunks[0], None, jax.random.fold_in(rng, 0))
-        if not probe_payload:
-            raise TypeError(
-                f"TwoShotAllreduce needs a wire payload to scatter; "
-                f"{type(compressor).__name__} communicates inside compress "
-                "— use Allreduce instead.")
-        if not ctx_is_data_free(compressor, chunks.shape[1], chunks.dtype):
-            raise TypeError(
-                f"TwoShotAllreduce requires a data-free ctx; "
-                f"{type(compressor).__name__}.compress puts data-derived "
-                "arrays in ctx, and stage 3 decodes every rank's gathered "
-                "chunk with the rank-local ctx2 (built from this rank's own "
-                "divergent aggregate) — other ranks' chunks would decode "
-                "against the wrong values. Keep data-derived arrays in the "
-                "payload (they travel on the wire) or use "
-                "Allgather/Allreduce.")
-        treedef, static, _ = _split_ctx(probe_ctx)
-
-        def comp_one(chunk, c):
-            payload, ctx, _ = compressor.compress(
-                chunk, None, jax.random.fold_in(rng, c))
-            _, _, arrays = _split_ctx(ctx)
-            return tuple(payload), tuple(arrays)
-
+        # Stage 1: per-chunk compress under a chunk-folded shared key
+        # (shared shard plumbing; the data-free-ctx gate is what makes
+        # stage 3's decode of every rank's gathered chunk with the
+        # rank-local ctx2 — built from this rank's own divergent
+        # aggregate — sound).
         with trace_stage(f"{STAGE_EXCHANGE}/twoshot_stage1_compress"):
-            payloads, ctx_arrays = jax.vmap(comp_one)(chunks, jnp.arange(w))
+            payloads, ctx_arrays, treedef, static = _shard_compress(
+                compressor, chunks, rng, "TwoShotAllreduce")
 
         if self.stage2_feedback:
             from grace_tpu.memories import DgcMemory
@@ -482,12 +519,212 @@ class TwoShotAllreduce(Communicator):
 
 
 @dataclasses.dataclass(frozen=True)
+class RingAllreduce(Communicator):
+    """Hop-pipelined compressed ring all-reduce: O(k) wire per rank.
+
+    The classic ring decomposition (reduce-scatter around the ring, then
+    all-gather the reduced shards) with the payload kept **compressed on
+    every hop** — the regime EQuARX (quantized allreduce decomposed inside
+    XLA, arXiv:2506.17615) and DynamiQ (compressed multi-hop all-reduce,
+    arXiv:2602.08923) target. Expressed with ``lax.ppermute`` over the mesh
+    axis so XLA schedules the W−1 neighbor exchanges on ICI:
+
+    1. split the compensated gradient into W equal shards
+       (``Communicator.shard_spec``); compress each with a shard-folded
+       shared key (the stage-1 encode shared with ``TwoShotAllreduce`` —
+       error-feedback memories see exactly this reconstruction);
+    2. **reduce-scatter**, W−1 hops: at hop s rank i sends the running
+       partial of shard (i−1−s) mod W to rank i+1 and receives shard
+       (i−2−s) mod W from rank i−1; each hop decompresses the received
+       payload, accumulates its own stage-1 contribution for that shard,
+       and — on the requant path — re-compresses the partial for the next
+       hop. After the last hop rank i holds the full reduction of shard i;
+    3. **all-gather** the W reduced shards, still in wire format; every
+       rank decodes all W and reassembles.
+
+    Wire per rank ≈ 2·(W−1)/W·k received (like two-shot) vs allgather's
+    (W−1)·k, and the aggregation work is spread around the ring instead of
+    replicated on every rank (allgather) or concentrated on the shard owner
+    (two-shot). Two accumulation paths, gated on the compressor — the
+    compatibility matrix is *enforced*, not documented:
+
+    * **exact path** (``summable_payload=True``: none, fp16/bf16, randomk)
+      — the codec is linear, so hops add wire words directly (payload-space
+      accumulation). No requant round-trip, no per-hop loss beyond the
+      accumulation dtype; phase 2 gathers the summed payloads themselves.
+    * **requant path** (``supports_hop_requant=True``: topk, qsgd, signsgd)
+      — decompress → accumulate → requantize at each hop with a shared hop
+      key (data-free ctx lets the receiver derive the sender's ctx
+      locally). Each intermediate requant adds one codec error that is NOT
+      covered by error feedback (the memory covers only the stage-1 encode,
+      like two-shot's stage-2 loss) — W−2 intermediate hops + the final
+      shard encode, so the requant error grows ~linearly in W. For
+      vote codecs (signsgd) the hop requant re-signs the running partial —
+      a *cascaded* vote whose result can differ from the one-shot majority
+      on split coordinates (unanimous coordinates are preserved exactly).
+
+    Works with any *stateless* codec (same gate as two-shot; powersgd
+    communicates inside compress and is rejected at the wire-payload
+    check). ``average`` divides the owned shard by W before the gather.
+    Per-hop spans are named under ``STAGE_RING_HOP`` in device traces.
+    The hop loop is unrolled at trace time (W−1 ppermutes of statically
+    shaped payloads) — compile cost grows with W, the trade XLA's static
+    ring collectives make themselves.
+    """
+
+    shard_parallel = True
+
+    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
+                        vote: bool = False) -> int:
+        # (W-1) reduce-scatter hop payloads + (W-1) gathered shard
+        # payloads, each ~payload/W: ≈ 2·payload·(W-1)/W, flat in W.
+        return 2 * payload_nbytes * (world - 1) // max(1, world)
+
+    def step(self, x: jax.Array, mem_state, comp_state,
+             memory, compressor: Compressor, rng: jax.Array):
+        if comp_state is not None:
+            raise TypeError(
+                f"RingAllreduce requires a stateless compressor; "
+                f"{type(compressor).__name__} carries cross-step state "
+                "(init_state != None) that has no per-shard meaning — use "
+                "Allgather/Allreduce instead.")
+        exact = bool(getattr(compressor, "summable_payload", False))
+        requant = bool(getattr(compressor, "supports_hop_requant", False))
+        if not (exact or requant):
+            raise TypeError(
+                f"RingAllreduce keeps the payload compressed on every hop, "
+                "which needs either a linear codec (summable_payload=True: "
+                "none/fp16/randomk — exact payload-space accumulation) or "
+                "one that opts into per-hop requantization "
+                "(supports_hop_requant=True: topk/qsgd/signsgd); "
+                f"{type(compressor).__name__} declares neither — its "
+                "payload carries structure a partial sum destroys. Use "
+                "Allgather (general-purpose) or TwoShotAllreduce instead.")
+        shape, dtype = x.shape, x.dtype
+        compensated, mem_state = memory.compensate(x, mem_state)
+        flat = compensated.reshape(-1)
+        n = flat.size
+        w, _, pad = self.shard_spec(n)              # static at trace time
+        chunks = jnp.pad(flat, (0, pad)).reshape(w, -1)
+
+        with trace_stage(f"{STAGE_EXCHANGE}/ring_stage1_compress"):
+            payloads, ctx_arrays, treedef, static = _shard_compress(
+                compressor, chunks, rng, "RingAllreduce")
+
+        # Error feedback covers the stage-1 encode exactly (the hop requant
+        # losses are downstream of it, like two-shot's stage-2 loss).
+        view_ctx = (treedef, static, ctx_arrays, n, shape, dtype, None)
+        mem_state = memory.update(compensated, payloads, view_ctx,
+                                  _ChunkedView(compressor), mem_state)
+
+        i = lax.axis_index(self.axis_name)
+        perm = [(j, (j + 1) % w) for j in range(w)]
+
+        def take_payload(stack, c):
+            return tuple(jnp.take(t, c, axis=0) for t in stack)
+
+        def shard_ctx(c):
+            return _join_ctx(treedef, static,
+                             [jnp.take(a, c, axis=0) for a in ctx_arrays])
+
+        if exact:
+            # Payload-space accumulation: decode-the-sum == sum-the-decodes
+            # (the Allreduce linearity condition), so the wire format IS
+            # the accumulator and phase 2 needs no re-encode.
+            send = take_payload(payloads, (i - 1) % w)
+            for s in range(w - 1):
+                with trace_stage(f"{STAGE_RING_HOP}/{s}"):
+                    recv = tuple(lax.ppermute(t, self.axis_name, perm)
+                                 for t in send)
+                    own = take_payload(payloads, (i - 2 - s) % w)
+                    send = tuple(r + o for r, o in zip(recv, own))
+            owned = send                 # wire-format reduction of shard i
+            if compressor.average:
+                if not all(jnp.issubdtype(t.dtype, jnp.inexact)
+                           for t in owned):
+                    raise TypeError(
+                        "RingAllreduce with average=True requires float "
+                        f"payloads; got {[t.dtype for t in owned]} — "
+                        "integer-coded payloads cannot carry the mean "
+                        "(reference compatibility matrix, "
+                        "IMPLEMENTING.md:43-45).")
+                owned = tuple(t / w for t in owned)
+            with trace_stage(f"{STAGE_EXCHANGE}/ring_all_gather"):
+                gathered = tuple(
+                    lax.all_gather(t, self.axis_name, axis=0, tiled=False)
+                    for t in owned)
+            with trace_stage(STAGE_DECOMPRESS):
+                # gathered[j] is rank j's owned shard == shard j, so the
+                # stacked stage-1 ctx arrays align by construction.
+                def dec(p, arrs):
+                    return compressor.decompress(
+                        p, _join_ctx(treedef, static, list(arrs)))
+
+                out = jax.vmap(dec)(gathered, ctx_arrays)
+        else:
+            hop_ctx = None
+            send = take_payload(payloads, (i - 1) % w)
+            partial = None
+            for s in range(w - 1):
+                with trace_stage(f"{STAGE_RING_HOP}/{s}"):
+                    recv = tuple(lax.ppermute(t, self.axis_name, perm)
+                                 for t in send)
+                    rc = (i - 2 - s) % w
+                    # Hop 0 arrives in stage-1 format (per-shard keys);
+                    # later hops in the previous hop's requant format. The
+                    # receiver's own compress at the same shared key
+                    # produced identical (data-free) ctx arrays, so the
+                    # local hop_ctx decodes the neighbor's payload.
+                    rctx = shard_ctx(rc) if s == 0 else hop_ctx
+                    partial = (compressor.decompress(recv, rctx)
+                               + compressor.decompress(
+                                   take_payload(payloads, rc),
+                                   shard_ctx(rc)))
+                    if s < w - 2:
+                        pay, hop_ctx, _ = compressor.compress(
+                            partial, None,
+                            jax.random.fold_in(rng, w + 1 + s))
+                        send = tuple(pay)
+            if partial is None:                     # w == 1: nothing moved
+                partial = compressor.decompress(take_payload(payloads, 0),
+                                                shard_ctx(0))
+            # Singleton stack: sum codecs pass through, vote codecs re-sign
+            # the final tally — the one place the aggregate differs.
+            owned = compressor.aggregate(partial[None])
+            if compressor.average:
+                owned = owned / w
+            # Phase 2: one final shard encode under a shared key, gather
+            # still in wire format, decode all W shards locally.
+            payload2, ctx2, _ = compressor.compress(
+                owned.astype(chunks.dtype), None, jax.random.fold_in(rng, w))
+            with trace_stage(f"{STAGE_EXCHANGE}/ring_all_gather"):
+                gathered = tuple(
+                    lax.all_gather(t, self.axis_name, axis=0, tiled=False)
+                    for t in payload2)
+            with trace_stage(STAGE_DECOMPRESS):
+                out = jax.vmap(
+                    lambda p: compressor.decompress(p, ctx2))(gathered)
+        out = out.reshape(-1)[:n].reshape(shape).astype(dtype)
+        return out, mem_state, comp_state
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        raise TypeError("RingAllreduce re-shards the gradient before "
+                        "compression; it only supports the full step() "
+                        "pipeline, not a bare exchange().")
+
+
+@dataclasses.dataclass(frozen=True)
 class Identity(Communicator):
     """No-op communicator: decompress this rank's own payload.
 
     No reference analog; used for single-device debugging and as the
     injectable no-comm fake the reference never wrote (SURVEY.md §4).
     """
+
+    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
+                        vote: bool = False) -> int:
+        return 0
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:

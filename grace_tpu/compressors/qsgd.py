@@ -21,6 +21,13 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 
 @dataclasses.dataclass(frozen=True)
 class QSGDCompressor(Compressor):
+    # Ring hop requant (comm.RingAllreduce): re-quantizing a partial sum is
+    # exactly QSGD applied to a fresh tensor — unbiased, with per-element
+    # error <= ||partial||/quantum_num per hop (the EQuARX-style quantized
+    # multi-hop accumulation regime). Errors add over the W-2 intermediate
+    # hops; raise quantum_num on large rings if the tail matters.
+    supports_hop_requant = True
+
     quantum_num: int = 64
     # Fused Pallas TPU kernel for the quantize step (in-core PRNG, one HBM
     # pass — see grace_tpu/ops/pallas_quant.py). 'auto' (the default, also

@@ -28,6 +28,13 @@ def _signs_to_float(bits: jax.Array, dtype) -> jax.Array:
 class SignSGDCompressor(Compressor):
     average = False
     vote_aggregate = True   # aggregate IS the majority vote (SignAllreduce-safe)
+    # Ring hop requant (comm.RingAllreduce): re-signing the running partial
+    # at each hop is a CASCADED vote — unanimous coordinates survive
+    # exactly, split coordinates weight later ranks more than a one-shot
+    # majority (ties resolve +1). A deliberate 1-bit-wire relaxation; the
+    # exact fixed-cost vote remains SignAllreduce. (Signum inherits the
+    # flag but is stateful, so the ring's stateless gate rejects it first.)
+    supports_hop_requant = True
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

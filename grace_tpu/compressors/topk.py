@@ -41,6 +41,13 @@ def static_k(numel: int, ratio: float) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class TopKCompressor(Compressor):
+    # Ring hop requant (comm.RingAllreduce): re-selecting top-k over a
+    # partial sum of sparsified shards is the standard multi-hop relaxation
+    # (DynamiQ-style re-sparsification) — the survivors of earlier hops
+    # compete with the new contribution, and dropped mass is bounded by the
+    # per-hop selection error. Sound for any selection algorithm here.
+    supports_hop_requant = True
+
     compress_ratio: float = 0.3
     algorithm: str = "exact"      # 'exact' | 'approx' | 'chunk'
     recall_target: float = 0.95   # for 'approx'

@@ -89,12 +89,25 @@ class Compressor:
       *documents* this compatibility matrix (IMPLEMENTING.md:43-45) and
       silently corrupts gradients for e.g. topk+Allreduce; here ``Allreduce``
       enforces it. Default False: a new codec must opt in.
+    * ``supports_hop_requant`` — True iff re-running ``compress`` on a
+      *partial sum of decompressed tensors* is a sane (bounded-error)
+      re-encoding, which is what the hop-pipelined
+      :class:`~grace_tpu.comm.RingAllreduce` does at every reduce-scatter
+      hop: decompress → accumulate → requantize (topk re-selects over the
+      partial, qsgd re-quantizes against the partial's norm, signsgd
+      re-signs — a cascaded vote). Codecs whose payload carries structure a
+      partial sum destroys (dgc/threshold capacity masks, onebit's mean
+      pair, sketch's bins) must leave this False; linear codecs don't need
+      it (``summable_payload`` gives them the exact payload-space
+      accumulation path instead). Like ``summable_payload``, this is an
+      *enforced* compatibility gate, not documentation. Default False.
     """
 
     average = True
     tensors_size_are_same = True
     vote_aggregate = False
     summable_payload = False
+    supports_hop_requant = False
 
     # -- cross-step state ---------------------------------------------------
     def init_state(self, x: jax.Array) -> State:
@@ -164,8 +177,43 @@ class Communicator:
 
     axis_name: str = DEFAULT_AXIS
 
+    # True for communicators that re-chunk the gradient into per-rank shards
+    # inside ``step`` (TwoShotAllreduce, RingAllreduce). Shard-parallel
+    # steps carry their own collective schedule (all_to_all / ppermute) and
+    # are not a validated target for ``fusion='grouped'`` vmapping — the
+    # transform gates on this flag at build time.
+    shard_parallel = False
+
     def world_size(self) -> jax.Array:
         return lax.psum(1, self.axis_name)
+
+    def shard_spec(self, n: int) -> tuple[int, int, int]:
+        """Equal-shard split of an ``n``-element flat buffer over the bound
+        mesh axis: ``(world, shard_elems, pad)`` with
+        ``world * shard_elems == n + pad``. The chunk schedule shared by the
+        shard-parallel communicators (``TwoShotAllreduce``,
+        ``RingAllreduce``); must be called where ``axis_name`` is bound, and
+        is static at trace time (XLA shapes stay static)."""
+        w = axis_size(self.axis_name)
+        pad = (-n) % w
+        return w, (n + pad) // w, pad
+
+    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
+                        vote: bool = False) -> int:
+        """Logical bytes RECEIVED per rank per step at world size ``world``.
+
+        ``payload_nbytes`` is one rank's whole-gradient payload
+        (:func:`grace_tpu.utils.metrics.payload_nbytes`), ``n_elems`` the
+        dense element count (vote collectives move dense bf16 votes, not the
+        packed payload), ``vote`` whether the exchange takes a majority-vote
+        route. This is the communicator-aware wire model shared by the bench
+        projections (``bench.recv_bytes_model``) and the in-graph telemetry
+        ring's ``wire_bytes`` field — payload bytes alone are communicator-
+        blind and cannot rank e.g. ring/two-shot's O(k) against allgather's
+        O(W·k). Default: gather-style, every other rank's payload arrives
+        (``Allgather``/``Broadcast``); reduce-style subclasses override.
+        """
+        return payload_nbytes * max(0, world - 1)
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:

@@ -141,6 +141,8 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
         return comm.TwoShotAllreduce(
             axis_name=axis,
             stage2_feedback=bool(params.get("stage2_feedback", False)))
+    if name in ("ring", "ring_allreduce"):
+        return comm.RingAllreduce(axis_name=axis)
     if name in ("sign_allreduce", "signallreduce"):
         return comm.SignAllreduce(
             axis_name=axis,

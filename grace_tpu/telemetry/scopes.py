@@ -27,7 +27,8 @@ import jax
 __all__ = ["trace_stage", "STAGE_COMPENSATE", "STAGE_COMPRESS",
            "STAGE_EXCHANGE", "STAGE_DECOMPRESS", "STAGE_MEMORY_UPDATE",
            "STAGE_FWD_BWD", "STAGE_OPTIMIZER", "STAGE_APPLY",
-           "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS"]
+           "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS",
+           "STAGE_RING_HOP"]
 
 # Canonical stage names — one vocabulary for the profiler, the report tool,
 # and the docs. Keep in sync with README "Observability".
@@ -42,6 +43,10 @@ STAGE_APPLY = "grace/apply_updates"
 STAGE_TELEMETRY = "grace/telemetry"
 STAGE_DENSE_ESCAPE = "grace/dense_escape"
 STAGE_CONSENSUS = "grace/consensus"
+# RingAllreduce reduce-scatter hops: each of the W-1 neighbor exchanges
+# (ppermute + decompress + accumulate + requantize) renders as its own
+# "grace/ring_hop/<s>" span, so per-hop cost is attributable in a trace.
+STAGE_RING_HOP = "grace/ring_hop"
 
 
 @contextlib.contextmanager

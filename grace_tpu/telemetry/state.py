@@ -56,8 +56,13 @@ FIELDS = (
     ("residual_norm", "mean"),      # ‖error-feedback memory state‖ per rank
     ("residual_max", "max"),        # max |residual| — EF health / drift alarm
     ("compression_error", "mean"),  # ‖g − decompress(compress(g))‖ / ‖g‖
-    ("wire_bytes", "first"),        # EFFECTIVE payload bytes this step
-    ("dense_bytes", "first"),       # dense cost of the same gradients
+    ("wire_bytes", "first"),        # EFFECTIVE bytes received per rank this
+                                    # step — communicator-aware
+                                    # (Communicator.recv_wire_bytes), so
+                                    # ring/two-shot's O(k) and allgather's
+                                    # O(W·k) are comparable on one scale
+    ("dense_bytes", "first"),       # raw dense bytes of the same gradients
+                                    # (codec/communicator-blind reference)
     ("fallback", "max"),            # 1.0 while the dense escape hatch is live
     ("audit_bytes", "first"),       # consensus-audit wire cost this step:
                                     # fingerprint exchange + any repair

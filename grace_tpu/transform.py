@@ -53,7 +53,8 @@ import numpy as np
 import optax
 from jax import lax
 
-from grace_tpu.core import Communicator, Compressor, Memory, State
+from grace_tpu.core import (Communicator, Compressor, Memory, State,
+                            axis_size)
 from grace_tpu.telemetry.scopes import STAGE_TELEMETRY, trace_stage
 from grace_tpu.telemetry.state import (TelemetryConfig, telemetry_init,
                                        telemetry_record)
@@ -306,8 +307,11 @@ def grace_transform(compressor: Compressor, memory: Memory,
     update then records per-step scalars — gradient/update norms,
     residual-memory norm and max (error-feedback health), the relative
     compression error ``‖g − decompress(compress(g))‖/‖g‖``, and the
-    *effective* wire bytes, which flip to the ``escape`` codec's dense cost
-    while the fallback flag is set — into a bounded on-device ring buffer
+    *effective* wire bytes — COMMUNICATOR-AWARE bytes received per rank per
+    step (``Communicator.recv_wire_bytes``: allgather pays (W−1)·payload,
+    ring/two-shot ≈2·payload·(W−1)/W), which flip to the ``escape`` codec's
+    dense psum cost while the fallback flag is set — into a bounded
+    on-device ring buffer
     (``GraceState.telem``) with zero host syncs; drain it with
     :class:`grace_tpu.telemetry.TelemetryReader`. The compression-error
     metric re-runs compress→decompress on the step's gradients (XLA CSEs
@@ -337,6 +341,17 @@ def grace_transform(compressor: Compressor, memory: Memory,
         raise ValueError(f"fusion must be None, 'flat', 'grouped', or int "
                          f"bytes; got {fusion!r}")
     grouped = fusion == "grouped"
+    if grouped and getattr(communicator, "shard_parallel", False):
+        raise ValueError(
+            "fusion='grouped' vmaps the per-leaf pipeline over leaf stacks "
+            "and is validated for the exchange-based communicator families "
+            "(Allreduce/Allgather/Broadcast/SignAllreduce/Identity); "
+            f"{type(communicator).__name__} re-chunks the gradient into "
+            "per-rank shards inside step() (shard-parallel family: "
+            "TwoShotAllreduce/RingAllreduce), and vmapping its "
+            "all_to_all/ppermute schedule is not a traced path — use "
+            "fusion=None, 'flat', or integer byte buckets, which hand the "
+            "communicator whole buffers to shard.")
     bucket_bytes = None if fusion == "flat" else fusion
     fused = fusion is not None and not grouped
 
@@ -395,6 +410,24 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     "fusion config).")
             outs = [None] * len(leaves)
             for gi, idxs in enumerate(groups):
+                # Group COUNT can coincide between fusion settings (e.g. a
+                # per-leaf state whose leaves all have distinct shapes);
+                # the stacked leading dim cannot — validate it here so a
+                # stale state raises the re-init message instead of an
+                # opaque vmap batch-dimension error.
+                for leaf in jax.tree_util.tree_leaves((mem[gi], comp[gi])):
+                    if hasattr(leaf, "shape") and (
+                            jnp.ndim(leaf) < 1
+                            or leaf.shape[0] != len(idxs)):
+                        raise ValueError(
+                            f"grace state group {gi} has a leaf of shape "
+                            f"{jnp.shape(leaf)} but the group stacks "
+                            f"{len(idxs)} same-shaped leaves (expected "
+                            f"leading dim {len(idxs)}) — the state was "
+                            "built under a different fusion setting. "
+                            "Re-init the optimizer state (or restore a "
+                            "checkpoint written with the same fusion "
+                            "config).")
                 stacked = jnp.stack([leaves[i] for i in idxs])
                 keys = jax.random.split(
                     jax.random.fold_in(step_key, gi), len(idxs))
@@ -470,22 +503,39 @@ def grace_transform(compressor: Compressor, memory: Memory,
 
     _wire_plan_cache: dict = {}
 
-    def _wire_plan(leaves):
-        """(dense, compressed, escape) logical payload bytes for these
-        leaves under the active fusion mode. Static Python ints, cached per
-        leaf signature — eval_shape tracing inside ``payload_nbytes`` is a
-        trace-time cost paid once per (shape, dtype) set, never at run
-        time. Same logical-vs-padded-bytes caveat as
+    def _bound_axis_size(axis_name) -> int:
+        """Static world size when the mesh axis is bound (inside
+        shard_map/pjit, the normal train-step case); 1 when it is not
+        (single-process use, e.g. the Identity communicator outside a
+        mesh)."""
+        try:
+            return int(axis_size(axis_name))
+        except NameError:       # unbound axis name
+            return 1
+
+    def _wire_plan(leaves, world):
+        """(dense, recv, escape_recv) logical bytes for these leaves under
+        the active fusion mode at world size ``world``. ``dense`` is the
+        raw dense gradient bytes (the codec- and communicator-blind
+        reference); ``recv``/``escape_recv`` are COMMUNICATOR-AWARE bytes
+        received per rank per step (``Communicator.recv_wire_bytes``) —
+        payload bytes alone cannot rank e.g. ring/two-shot's O(k) against
+        allgather's O(W·k) received. Static Python ints, cached per
+        (leaf signature, world) — eval_shape tracing inside
+        ``payload_nbytes`` is a trace-time cost paid once per shape set,
+        never at run time. Same logical-vs-padded-bytes caveat as
         :func:`grace_tpu.utils.metrics.wire_report`."""
         from grace_tpu.utils.metrics import payload_nbytes
 
         sig = tuple((tuple(jnp.shape(l)), str(jnp.result_type(l)))
                     for l in leaves)
-        plan = _wire_plan_cache.get(sig)
+        plan = _wire_plan_cache.get((sig, world))
         if plan is not None:
             return plan
         structs = [jax.ShapeDtypeStruct(shape, jnp.dtype(d))
                    for shape, d in sig]
+        n_elems = sum(int(np.prod(s.shape, dtype=np.int64))
+                      for s in structs)
         dense = sum(int(np.prod(s.shape, dtype=np.int64)) * s.dtype.itemsize
                     for s in structs)
         if grouped:
@@ -500,9 +550,20 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 for idxs in buckets)
         else:
             comp_b = sum(payload_nbytes(compressor, s) for s in structs)
-        esc_b = (sum(payload_nbytes(escape, s) for s in structs)
-                 if escape is not None else None)
-        plan = _wire_plan_cache[sig] = (dense, comp_b, esc_b)
+        vote = bool(getattr(compressor, "vote_aggregate", False))
+        recv = communicator.recv_wire_bytes(comp_b, n_elems, world,
+                                            vote=vote)
+        if escape is not None:
+            from grace_tpu.comm import Allreduce
+            esc_b = sum(payload_nbytes(escape, s) for s in structs)
+            # The escape hatch is a dense psum all-reduce of the escape
+            # payload — price it with the Allreduce ring model.
+            esc_recv = Allreduce(
+                axis_name=communicator.axis_name).recv_wire_bytes(
+                    esc_b, n_elems, world)
+        else:
+            esc_recv = None
+        plan = _wire_plan_cache[(sig, world)] = (dense, recv, esc_recv)
         return plan
 
     def _sqsum(ls) -> jax.Array:
@@ -558,7 +619,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 "without telemetry (or restored from such a checkpoint). "
                 "Re-init the optimizer state with the telemetry-enabled "
                 "transform.")
-        dense_b, comp_b, esc_b = _wire_plan(leaves)
+        dense_b, comp_b, esc_b = _wire_plan(
+            leaves, _bound_axis_size(communicator.axis_name))
         grad_norm = jnp.sqrt(_sqsum(leaves))
         update_norm = jnp.sqrt(_sqsum(outs))
         mem_leaves = [l for l in jax.tree_util.tree_leaves(new_mem)

@@ -48,6 +48,27 @@ def test_digits_topk_bf16_residual_floor():
 
 
 @pytest.mark.slow
+@pytest.mark.ring
+def test_digits_topk_ring_floor():
+    """ISSUE 4 acceptance: the hop-pipelined compressed ring converges on
+    real data through the full transform — per-hop re-selection (W-1 hops,
+    W-2 intermediate requants) must stay inside what error feedback plus
+    SGD noise absorb. The intermediate requants are NOT covered by error
+    feedback (IMPLEMENTING.md "Per-hop requantization"), so the curve lags
+    allgather's slightly: measured 97.2% at epoch 45 (vs allgather's 98.9%
+    at 60) — the floor is set conservatively below the deterministic
+    plateau, and a broken ring lands at 10-60%."""
+    import digits_lenet
+
+    acc = digits_lenet.run([
+        "--compressor", "topk", "--compress-ratio", "0.01",
+        "--memory", "residual", "--communicator", "ring",
+        "--epochs", "45",
+    ])
+    assert acc >= 0.96, f"digits Top-K 1% + ring convergence regressed: acc={acc}"
+
+
+@pytest.mark.slow
 def test_real_mnist_topk_floor():
     """Flagship real-data evidence (VERDICT round-2 item 3): LeNet on the
     bundled 10k real MNIST images through Top-K 1% + residual on the mesh.

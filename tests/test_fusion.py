@@ -241,6 +241,44 @@ class TestGroupedFusion:
         tx_p = optax.chain(grc_p.transform(seed=1), optax.sgd(0.1))
         state = init_train_state(_mlp_params(np.random.default_rng(1)),
                                  tx_p, mesh)   # per-leaf state...
+        # 8 per-leaf entries vs 4 shape groups: the count check must raise
+        # the intended re-init message, not an opaque vmap batch error.
         step = make_train_step(_mlp_loss, tx_g, mesh, donate=False)
-        with pytest.raises(Exception, match="group|fusion"):
+        with pytest.raises(ValueError,
+                           match="different fusion setting.*Re-init"):
             step(state, batch)   # ...fed to a grouped transform
+
+    def test_grouped_state_count_coincidence_raises(self, mesh):
+        """All-distinct-shaped leaves make the per-leaf state count EQUAL
+        the grouped group count (one leaf per group), so the old
+        len()-only check passed a stale state straight into vmap. The
+        per-group stacked-leading-dim validation must catch it with the
+        same re-init message."""
+        cfg = {"compressor": "topk", "compress_ratio": 0.3,
+               "memory": "residual", "communicator": "allgather"}
+        rng = np.random.default_rng(0)
+        batch = _make_problem(rng)
+        grc_g = grace_from_params({**cfg, "fusion": "grouped"})
+        tx_g = optax.chain(grc_g.transform(seed=1), optax.sgd(0.1))
+        grc_p = grace_from_params(cfg)
+        tx_p = optax.chain(grc_p.transform(seed=1), optax.sgd(0.1))
+        # w (12,3) and b (3,): two distinct shapes -> 2 groups == 2 leaves
+        state = init_train_state(_params(np.random.default_rng(1)),
+                                 tx_p, mesh)   # per-leaf state, count 2
+        step = make_train_step(_loss_fn, tx_g, mesh, donate=False)
+        with pytest.raises(ValueError,
+                           match="leading dim.*different fusion setting"):
+            step(state, batch)
+
+    @pytest.mark.parametrize("communicator", ["twoshot", "ring"])
+    def test_grouped_shard_parallel_rejected(self, communicator):
+        """fusion='grouped' x a shard-parallel communicator is an untraced
+        path (vmapping the all_to_all/ppermute schedule): build-time
+        ValueError naming the supported families, not a silent trace."""
+        grc = grace_from_params({"compressor": "topk",
+                                 "compress_ratio": 0.3,
+                                 "memory": "residual",
+                                 "communicator": communicator,
+                                 "fusion": "grouped"})
+        with pytest.raises(ValueError, match="shard-parallel|grouped"):
+            grc.transform()

@@ -110,13 +110,22 @@ def test_fifty_step_jsonl_with_provenance(mesh, tmp_path):
     assert header["provenance"]["platform"] == "cpu"
     assert len(records) == 50
     assert [r["step"] for r in records] == list(range(50))
+    from grace_tpu.compressors import TopKCompressor
     for rec in records:
         for field in REQUIRED:
             assert field in rec, field
         assert np.isfinite(rec["grad_norm"]) and rec["grad_norm"] > 0
         assert rec["residual_norm"] >= 0
         assert 0 <= rec["compression_error"] <= 1.5
-        assert rec["wire_bytes"] < rec["dense_bytes"]
+        # wire_bytes is COMMUNICATOR-AWARE received bytes (ISSUE 4):
+        # allgather pays (W-1)x one rank's payload — more than this
+        # config's raw dense gradient bytes at W=8 with 30% density,
+        # which is exactly the scaling the ring communicator fixes.
+        leaves = jax.tree_util.tree_leaves(_init_params())
+        comp_b = sum(payload_nbytes(
+            TopKCompressor(compress_ratio=0.3), l) for l in leaves)
+        assert rec["wire_bytes"] == comp_b * 7
+        assert rec["dense_bytes"] == sum(l.size * 4 for l in leaves)
     assert reader.flushes == 5 and reader.dropped == 0
 
 
@@ -227,10 +236,18 @@ def test_effective_wire_bytes_flip_across_fallback_window(mesh):
     state, step = _build(mesh, params)
 
     leaves = jax.tree_util.tree_leaves(_init_params())
+    from grace_tpu.comm import Allgather, Allreduce
     from grace_tpu.compressors import FP16Compressor, TopKCompressor
-    esc_bytes = sum(payload_nbytes(FP16Compressor(), l) for l in leaves)
-    comp_bytes = sum(payload_nbytes(TopKCompressor(compress_ratio=0.3), l)
-                     for l in leaves)
+    n_elems = sum(l.size for l in leaves)
+    # wire_bytes records COMMUNICATOR-AWARE received bytes (ISSUE 4): the
+    # compressed path rides this config's allgather, the escape hatch a
+    # dense psum priced by the Allreduce ring model.
+    esc_bytes = Allreduce().recv_wire_bytes(
+        sum(payload_nbytes(FP16Compressor(), l) for l in leaves),
+        n_elems, 8)
+    comp_bytes = Allgather().recv_wire_bytes(
+        sum(payload_nbytes(TopKCompressor(compress_ratio=0.3), l)
+            for l in leaves), n_elems, 8)
     assert esc_bytes != comp_bytes
 
     reader = TelemetryReader(sink=None, every=100)

@@ -78,6 +78,13 @@ CONFIGS = [
      "communicator": "twoshot"},
     {"compressor": "qsgd", "quantum_num": 64, "memory": "none",
      "communicator": "twoshot"},
+    # Hop-pipelined compressed ring (ISSUE 4): per-hop requantization must
+    # still converge through the full transform (topk re-selects, qsgd
+    # re-quantizes at each of the W-1 reduce-scatter hops).
+    {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+     "communicator": "ring"},
+    {"compressor": "qsgd", "quantum_num": 64, "memory": "none",
+     "communicator": "ring"},
 ]
 
 

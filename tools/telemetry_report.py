@@ -130,10 +130,12 @@ def render(provenance, records, events,
         if wire and dense:
             out.append("")
             out.append("== wire traffic ==")
-            out.append(f"  effective payload bytes, total: "
-                       f"{int(sum(wire)):,d} (dense would be "
+            out.append(f"  effective bytes received per rank, total: "
+                       f"{int(sum(wire)):,d} (raw dense gradient bytes "
                        f"{int(sum(dense)):,d}; ratio "
-                       f"{sum(wire) / max(sum(dense), 1):.4f})")
+                       f"{sum(wire) / max(sum(dense), 1):.4f} — "
+                       "communicator-aware, so allgather at scale can "
+                       "legitimately exceed 1.0)")
             wins = fallback_windows(records)
             if wins:
                 spans = ", ".join(f"{a}..{b}" for a, b in wins)
