@@ -784,20 +784,39 @@ def _write_evidence(rows: list, path: str, metric: str, n_expected: int,
             datetime.timezone.utc).isoformat(timespec="seconds"),
     }
     # Atomic replace: a kill mid-write must not truncate the evidence the
-    # row-by-row persistence exists to protect. And never let a lesser
-    # record clobber a better one (a fresh attempt starts with rows=[];
-    # its 1-row partial must not erase an earlier complete run or a longer
-    # partial prefix) — demoted records go to a '.partial' sibling instead.
+    # row-by-row persistence exists to protect — fsync before the rename or
+    # a power cut can land the rename with un-flushed content. And never
+    # let a lesser record clobber a better one (a fresh attempt starts with
+    # rows=[]; its 1-row partial must not erase an earlier complete run or
+    # a longer partial prefix) — demoted records go to a '.partial' sibling
+    # instead. Transient OSErrors (the flaky tunnel's NFS blips) retry with
+    # the same bounded backoff the checkpointer uses.
     tmp = path + ".tmp"
-    try:
+
+    def write():
         with open(tmp, "w") as f:
             json.dump(rec, f, indent=1)
             f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
         old = load_tpu_evidence(path)
         os.replace(tmp, path + ".partial" if _regresses(rec, old) else path)
+
+    try:
+        _evidence_retry_io(write, "TPU evidence")
     except OSError as e:
         print(f"[bench] could not save TPU evidence: {e}",
               file=sys.stderr, flush=True)
+
+
+def _evidence_retry_io(fn, what: str):
+    """checkpoint._retry_io when available (orbax pulls in heavy deps a
+    bench-only box may lack); single attempt otherwise."""
+    try:
+        from grace_tpu.checkpoint import _retry_io
+    except Exception:
+        return fn()
+    return _retry_io(fn, what)
 
 
 def _regresses(new: dict, old) -> bool:

@@ -1,0 +1,61 @@
+"""graft-lint: static SPMD auditing of the compressed-exchange pipeline.
+
+PRs 1-4 grew three hazard classes that only manifest at multi-chip runtime:
+collectives inside ``lax.cond`` branches (the guard/consensus/dense-escape
+conds) that can deadlock or desync ranks if branch structure diverges on a
+rank-varying predicate; bit-pattern data flowing through float-space
+reductions (the ``-0.0 + 0.0`` aliasing bug the consensus repair path fixed
+by hand in PR 3); and a hand-maintained ``Communicator.recv_wire_bytes``
+model that telemetry and bench both trust but nothing verified against the
+actual traced graph. EQuARX (PAPERS.md) shows quantized-collective
+correctness lives or dies on the XLA-level structure of the collective, and
+THC's homomorphic-compression argument is exactly a property that can be
+checked statically — so catch these at trace time on a CPU in CI, not at
+step 40k on a v4 pod.
+
+The auditor traces any registered codec x communicator x resilience config
+to a jaxpr with **no devices** (``AbstractMesh`` + ``shard_map``, see
+:mod:`.trace`) and walks it with composable passes (:mod:`.passes`):
+
+* ``collective_consistency`` — branch-divergent collective sequences under
+  a ``lax.cond``/``lax.while_loop`` whose predicate is not provably
+  replicated (cross-rank deadlock/desync);
+* ``bit_exactness`` — bit-pattern data (``bitcast_convert_type`` products:
+  fingerprints, checksums, masked-broadcast words) reaching a float-space
+  cross-replica reduction (the PR-3 ±0.0 aliasing bug class);
+* ``wire_reconciliation`` — per-rank received collective bytes counted
+  from the jaxpr vs the ``Communicator.recv_wire_bytes`` model, within the
+  tolerance documented in :mod:`grace_tpu.core`;
+* ``signature_stability`` — abstract state signature must be a fixed point
+  of ``update`` (weak-type promotions / Python-scalar closure leaks force a
+  retrace every step), and no host callbacks inside the compiled step.
+
+:mod:`.rules` adds an AST-level repo rule engine (compressor capability
+declarations, telemetry FIELDS reducers, pytest marker registration);
+``tools/graft_lint.py`` is the CLI; ``tests/test_analysis.py`` is the CI
+gate, including deliberately seeded bad graphs proving each pass fires.
+"""
+
+from grace_tpu.analysis.trace import (TracedGraph, abstract_mesh, trace_fn,
+                                      trace_train_step, trace_update)
+from grace_tpu.analysis.passes import (Finding, PASS_NAMES,
+                                       pass_bit_exactness,
+                                       pass_collective_consistency,
+                                       pass_signature_stability,
+                                       pass_wire_reconciliation, run_passes)
+from grace_tpu.analysis.configs import (AUDIT_CONFIGS, audit_all,
+                                        audit_config, build_grace)
+from grace_tpu.analysis.rules import RULE_NAMES, run_repo_rules
+from grace_tpu.analysis.report import (findings_to_json, render_text,
+                                       write_jsonl)
+
+__all__ = [
+    "TracedGraph", "abstract_mesh", "trace_fn", "trace_update",
+    "trace_train_step",
+    "Finding", "PASS_NAMES", "run_passes",
+    "pass_collective_consistency", "pass_bit_exactness",
+    "pass_wire_reconciliation", "pass_signature_stability",
+    "AUDIT_CONFIGS", "audit_all", "audit_config", "build_grace",
+    "RULE_NAMES", "run_repo_rules",
+    "findings_to_json", "render_text", "write_jsonl",
+]

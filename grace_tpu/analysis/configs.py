@@ -1,0 +1,200 @@
+"""The audited config registry: the enforced codec x communicator matrix.
+
+One entry per *valid* triad the repo supports (the same compatibility
+matrix ``Allreduce``/``RingAllreduce``/``TwoShotAllreduce`` enforce at
+build time, plus the resilience variants: escape hatch, telemetry,
+guard + consensus). ``audit_all`` traces every entry with
+:func:`~grace_tpu.analysis.trace.trace_update` (or
+:func:`~grace_tpu.analysis.trace.trace_train_step` for ``mode='train'``
+entries) and runs the selected passes.
+
+Pass selection per entry:
+
+* ``wire_reconciliation`` runs only on bare-update traces without an
+  escape hatch (the escape cond makes "the" wire cost bimodal — telemetry
+  prices that flip separately) and without in-compress collectives priced
+  analytically at a different granularity;
+* train-mode entries (guard/consensus) skip wire reconciliation — the
+  audit's fingerprint gathers and the loss pmean are deliberately outside
+  the exchange model — but are exactly where ``collective_consistency``
+  and ``bit_exactness`` earn their keep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from grace_tpu.analysis.passes import Finding, PASS_NAMES, run_passes
+from grace_tpu.analysis.trace import trace_train_step, trace_update
+
+__all__ = ["AUDIT_CONFIGS", "audit_all", "audit_config", "build_grace"]
+
+_ALL = tuple(PASS_NAMES)
+_NO_WIRE = tuple(p for p in PASS_NAMES if p != "wire_reconciliation")
+
+
+def _cfg(name: str, params: Dict[str, Any], *, passes=_ALL, mode="update",
+         guard=None, consensus=None) -> Dict[str, Any]:
+    return {"name": name, "params": params, "passes": passes, "mode": mode,
+            "guard": guard, "consensus": consensus}
+
+
+AUDIT_CONFIGS: List[Dict[str, Any]] = [
+    # -- linear codecs: the summable-payload Allreduce family ---------------
+    _cfg("none-allreduce", {"compressor": "none", "memory": "none",
+                            "communicator": "allreduce"}),
+    _cfg("fp16-allreduce", {"compressor": "fp16", "memory": "none",
+                            "communicator": "allreduce"}),
+    _cfg("randomk-allreduce", {"compressor": "randomk",
+                               "compress_ratio": 0.5, "memory": "residual",
+                               "communicator": "allreduce"}),
+    _cfg("powersgd-allreduce", {"compressor": "powersgd",
+                                "compress_rank": 2, "memory": "powersgd",
+                                "communicator": "allreduce"}),
+    # -- the general-purpose allgather family -------------------------------
+    _cfg("topk-allgather", {"compressor": "topk", "compress_ratio": 0.3,
+                            "memory": "residual",
+                            "communicator": "allgather"}),
+    _cfg("randomk-allgather", {"compressor": "randomk",
+                               "compress_ratio": 0.5, "memory": "residual",
+                               "communicator": "allgather"}),
+    _cfg("qsgd-allgather", {"compressor": "qsgd", "quantum_num": 64,
+                            "use_pallas": False, "memory": "none",
+                            "communicator": "allgather"}),
+    _cfg("terngrad-allgather", {"compressor": "terngrad", "memory": "none",
+                                "communicator": "allgather"}),
+    _cfg("signsgd-allgather", {"compressor": "signsgd", "memory": "none",
+                               "communicator": "allgather"}),
+    _cfg("signum-allgather", {"compressor": "signum", "momentum": 0.9,
+                              "memory": "none",
+                              "communicator": "allgather"}),
+    _cfg("efsignsgd-allgather", {"compressor": "efsignsgd", "lr": 0.1,
+                                 "memory": "efsignsgd",
+                                 "communicator": "allgather"}),
+    _cfg("onebit-allgather", {"compressor": "onebit", "memory": "residual",
+                              "communicator": "allgather"}),
+    _cfg("natural-allgather", {"compressor": "natural",
+                               "memory": "residual",
+                               "communicator": "allgather"}),
+    _cfg("dgc-allgather", {"compressor": "dgc", "compress_ratio": 0.3,
+                           "memory": "dgc", "communicator": "allgather"}),
+    _cfg("threshold-allgather", {"compressor": "threshold",
+                                 "threshold": 0.01,
+                                 "memory": "residual",
+                                 "communicator": "allgather"}),
+    _cfg("sketch-allgather", {"compressor": "sketch", "quantum_num": 64,
+                              "memory": "none",
+                              "communicator": "allgather"}),
+    _cfg("u8bit-allgather", {"compressor": "u8bit", "memory": "none",
+                             "communicator": "allgather"}),
+    _cfg("adaq-allgather", {"compressor": "adaq", "compress_ratio": 0.3,
+                            "memory": "residual",
+                            "communicator": "allgather"}),
+    _cfg("inceptionn-allgather", {"compressor": "inceptionn",
+                                  "memory": "none",
+                                  "communicator": "allgather"}),
+    _cfg("topk-broadcast", {"compressor": "topk", "compress_ratio": 0.3,
+                            "memory": "residual",
+                            "communicator": "broadcast"}),
+    # -- vote routing --------------------------------------------------------
+    _cfg("signsgd-sign_allreduce", {"compressor": "signsgd",
+                                    "memory": "none",
+                                    "communicator": "sign_allreduce"}),
+    _cfg("signsgd-allreduce-vote", {"compressor": "signsgd",
+                                    "memory": "none",
+                                    "communicator": "allreduce"}),
+    # -- shard-parallel families (flat fusion hands them whole buffers) -----
+    _cfg("topk-twoshot", {"compressor": "topk", "compress_ratio": 0.3,
+                          "memory": "residual", "communicator": "twoshot",
+                          "fusion": "flat"}),
+    _cfg("qsgd-twoshot", {"compressor": "qsgd", "quantum_num": 64,
+                          "use_pallas": False, "memory": "none",
+                          "communicator": "twoshot", "fusion": "flat"}),
+    _cfg("topk-ring", {"compressor": "topk", "compress_ratio": 0.3,
+                       "memory": "residual", "communicator": "ring",
+                       "fusion": "flat"}),
+    _cfg("qsgd-ring", {"compressor": "qsgd", "quantum_num": 64,
+                       "use_pallas": False, "memory": "none",
+                       "communicator": "ring", "fusion": "flat"}),
+    _cfg("signsgd-ring", {"compressor": "signsgd", "memory": "none",
+                          "communicator": "ring", "fusion": "flat"}),
+    _cfg("fp16-ring", {"compressor": "fp16", "memory": "none",
+                       "communicator": "ring", "fusion": "flat"}),
+    _cfg("randomk-ring", {"compressor": "randomk", "compress_ratio": 0.5,
+                          "memory": "residual", "communicator": "ring",
+                          "fusion": "flat"}),
+    # -- degenerate / fusion variants ---------------------------------------
+    _cfg("none-identity", {"compressor": "none", "memory": "none",
+                           "communicator": "identity"}),
+    _cfg("topk-allgather-flat", {"compressor": "topk",
+                                 "compress_ratio": 0.3,
+                                 "memory": "residual",
+                                 "communicator": "allgather",
+                                 "fusion": "flat"}),
+    _cfg("topk-allgather-grouped", {"compressor": "topk",
+                                    "compress_ratio": 0.3,
+                                    "memory": "residual",
+                                    "communicator": "allgather",
+                                    "fusion": "grouped"}),
+    # -- resilience variants: the conds the auditor exists for --------------
+    _cfg("topk-escape-telemetry",
+         {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+          "communicator": "allgather", "escape": "fp16", "telemetry": True},
+         passes=_NO_WIRE),
+    _cfg("topk-guard-consensus",
+         {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+          "communicator": "allgather", "escape": "fp16", "telemetry": True,
+          "consensus": True},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+    _cfg("ring-guard-consensus",
+         {"compressor": "qsgd", "quantum_num": 64, "use_pallas": False,
+          "memory": "none", "communicator": "ring", "fusion": "flat",
+          "escape": "fp16", "consensus": True},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+]
+
+
+def build_grace(entry: Dict[str, Any]):
+    """The Grace bundle for one registry entry."""
+    from grace_tpu.helper import grace_from_params
+    return grace_from_params(dict(entry["params"]))
+
+
+def audit_config(entry: Dict[str, Any], *, world: int = 8
+                 ) -> List[Finding]:
+    """Trace one registry entry (or an ad-hoc ``{'name', 'params', ...}``
+    dict) and run its passes. Trace failures surface as findings, not
+    exceptions — a config that stops tracing at all is itself a finding."""
+    name = entry["name"]
+    passes = tuple(entry.get("passes") or PASS_NAMES)
+    grace = entry.get("grace") or build_grace(entry)
+    meta = {"grace": grace, "params": entry.get("params")}
+    try:
+        if entry.get("mode", "update") == "train":
+            traced = trace_train_step(
+                grace, world=world, guard=entry.get("guard"),
+                consensus=entry.get("consensus"), name=name, meta=meta)
+        else:
+            traced = trace_update(grace, world=world, name=name, meta=meta)
+    except Exception as e:                               # noqa: BLE001
+        return [Finding(
+            pass_name="trace", config=name, severity="error",
+            message=(f"config failed to trace on the abstract mesh: "
+                     f"{type(e).__name__}: {e} — if this is a "
+                     "ConcretizationTypeError, a traced value is forcing a "
+                     "host sync (Python control flow / float() on a "
+                     "tracer), the exact retrace hazard pass 4 hunts"))]
+    return run_passes(traced, passes)
+
+
+def audit_all(configs: Optional[Sequence[Dict[str, Any]]] = None, *,
+              world: int = 8, progress=None) -> List[Finding]:
+    """Audit every registry config; returns the concatenated findings."""
+    findings: List[Finding] = []
+    for entry in (configs if configs is not None else AUDIT_CONFIGS):
+        if progress is not None:
+            progress(entry["name"])
+        findings.extend(audit_config(entry, world=world))
+    return findings

@@ -1,0 +1,521 @@
+"""The four composable jaxpr audit passes.
+
+Every pass takes a :class:`~grace_tpu.analysis.trace.TracedGraph` and
+returns a list of :class:`Finding`. Shared machinery:
+
+* **recursive equation walk** — collectives hide inside ``cond`` branches,
+  ``while`` bodies, ``pjit``/``custom_*_call`` sub-jaxprs and (post-vmap)
+  batched shapes; every pass sees the whole nest;
+* **replication analysis** — a forward dataflow pass over the body jaxpr:
+  a value is *rank-varying* when it descends from a rank-varying input
+  (sharded batch, per-rank residuals — seeded by the tracer from
+  ``partition_specs``) or from ``axis_index``, and becomes *replicated*
+  again when it passes through a full-axis ``psum``/``all_gather`` (every
+  rank computes the identical reduction). ``ppermute``/``all_to_all``
+  outputs are rank-varying by construction. This is what lets the
+  collective-consistency pass bless the dense-escape cond (its predicate
+  is the replicated fallback flag) while condemning a cond whose predicate
+  descends from local data;
+* **stage attribution** — each equation's ``source_info.name_stack``
+  carries the ``grace/...`` scope names from
+  :mod:`grace_tpu.telemetry.scopes`, so findings name the pipeline stage
+  (``grace/exchange``, ``grace/consensus``, ...) they sit in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from grace_tpu.analysis.trace import TracedGraph
+
+__all__ = ["Finding", "PASS_NAMES", "run_passes",
+           "pass_collective_consistency", "pass_bit_exactness",
+           "pass_wire_reconciliation", "pass_signature_stability",
+           "collective_signature", "count_recv_bytes"]
+
+# Cross-replica primitives, by behavior class. `pbroadcast` is check_rep
+# bookkeeping (identity on every rank), not a wire collective.
+_REDUCTIONS = frozenset({"psum", "psum2", "pmax", "pmin", "pmean"})
+_GATHERS = frozenset({"all_gather", "all_gather_invariant"})
+_PERMUTES = frozenset({"ppermute", "pshuffle"})
+_ALLTOALL = frozenset({"all_to_all"})
+_SCATTER = frozenset({"reduce_scatter"})
+COLLECTIVE_PRIMS = _REDUCTIONS | _GATHERS | _PERMUTES | _ALLTOALL | _SCATTER
+
+_CALLBACK_PRIMS = frozenset({
+    "io_callback", "debug_callback", "pure_callback", "callback",
+    "outside_call", "host_callback_call"})
+
+PASS_NAMES = ("collective_consistency", "bit_exactness",
+              "wire_reconciliation", "signature_stability")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``severity`` is ``'error'`` (CI-failing) or
+    ``'warning'``; ``stage`` is the ``grace/...`` trace-scope the offending
+    equation sits in (empty when unattributable)."""
+
+    pass_name: str
+    config: str
+    severity: str
+    message: str
+    stage: str = ""
+    details: Tuple[Tuple[str, Any], ...] = ()
+
+    def as_dict(self) -> dict:
+        return {"pass": self.pass_name, "config": self.config,
+                "severity": self.severity, "message": self.message,
+                "stage": self.stage, **dict(self.details)}
+
+
+def _stage_of(eqn) -> str:
+    """The canonical stage the equation was traced under: longest matching
+    ``grace/...`` scope from the shared vocabulary
+    (:data:`grace_tpu.telemetry.scopes.ALL_STAGES`), falling back to the
+    raw ``grace/`` segment for ad-hoc sub-scopes."""
+    from grace_tpu.telemetry.scopes import ALL_STAGES
+
+    try:
+        stack = str(eqn.source_info.name_stack)
+    except Exception:
+        return ""
+    for stage in ALL_STAGES:
+        if stage in stack:
+            return stage
+    segs = [seg for seg in stack.split("/") if seg]
+    if "grace" not in segs:
+        return ""
+    i = segs.index("grace")
+    return "/".join(segs[i:i + 2])
+
+
+def _axes_of(eqn) -> Tuple[str, ...]:
+    """The mesh axis names a collective equation operates over."""
+    p = eqn.params
+    axes = p.get("axes", p.get("axis_name", ()))
+    if isinstance(axes, str):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+def _sub_jaxprs_of(eqn):
+    out = []
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vs:
+            inner = getattr(item, "jaxpr", item)
+            if hasattr(inner, "eqns") and hasattr(inner, "invars"):
+                out.append(inner)
+    return out
+
+
+def _is_var(v) -> bool:
+    return hasattr(v, "aval") and not hasattr(v, "val")
+
+
+def _aval_nbytes(aval) -> int:
+    return int(np.prod(aval.shape, dtype=np.int64)) * aval.dtype.itemsize
+
+
+# ---------------------------------------------------------------------------
+# replication (rank-variance) dataflow
+# ---------------------------------------------------------------------------
+
+def _propagate_variance(jaxpr, axis_name: str,
+                        seed: Dict[Any, bool]) -> Dict[Any, bool]:
+    """Forward rank-variance over one jaxpr (recursing into sub-jaxprs).
+
+    Conservative in the safe direction: unknown structure propagates
+    variance, replication is only granted by full-axis reductions/gathers.
+    """
+    var: Dict[Any, bool] = {}
+    for v in jaxpr.invars:
+        var[v] = seed.get(v, True)
+    for v in jaxpr.constvars:
+        var[v] = False
+
+    def lookup(v) -> bool:
+        return var.get(v, False) if _is_var(v) else False
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        any_in = any(lookup(v) for v in eqn.invars)
+        if name == "axis_index":
+            out = axis_name in _axes_of(eqn) or any_in
+        elif name in _REDUCTIONS or name in _GATHERS:
+            # Full-axis reduction/gather over our axis: every rank computes
+            # the identical result (axis_index_groups would break that).
+            full = (axis_name in _axes_of(eqn)
+                    and eqn.params.get("axis_index_groups") is None)
+            out = False if full else any_in
+        elif name in _PERMUTES or name in _ALLTOALL or name in _SCATTER:
+            out = True
+        elif name == "pbroadcast":
+            out = any_in
+        else:
+            subs = _sub_jaxprs_of(eqn)
+            if subs:
+                # Map operand variance into each sub-jaxpr positionally
+                # where arities line up (cond drops the predicate operand;
+                # other call-like prims pass operands straight through) and
+                # OR the sub-results; fall back to any_in otherwise.
+                out_flags = []
+                for sub in subs:
+                    if name == "cond":
+                        ops = eqn.invars[1:]
+                    else:
+                        ops = eqn.invars
+                    if len(sub.invars) == len(ops):
+                        sub_seed = {sv: lookup(ov)
+                                    for sv, ov in zip(sub.invars, ops)}
+                        sub_var = _propagate_variance(sub, axis_name,
+                                                      sub_seed)
+                        out_flags.append(any(
+                            sub_var.get(ov, any_in) if _is_var(ov) else False
+                            for ov in sub.outvars))
+                    else:
+                        out_flags.append(any_in)
+                out = any(out_flags) or (name == "cond"
+                                         and lookup(eqn.invars[0]))
+            else:
+                out = any_in
+        for v in eqn.outvars:
+            var[v] = out
+    return var
+
+
+# ---------------------------------------------------------------------------
+# pass 1: collective consistency across cond/while branches
+# ---------------------------------------------------------------------------
+
+def collective_signature(jaxpr) -> Tuple:
+    """Ordered tuple of (prim, axes, operand shapes/dtypes, schedule params)
+    for every collective in ``jaxpr``, recursing into nested jaxprs in
+    equation order. Two branches with equal signatures issue the same
+    collective sequence and can never deadlock against each other."""
+    sig = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            operands = tuple(
+                (tuple(v.aval.shape), str(v.aval.dtype))
+                for v in eqn.invars if _is_var(v))
+            extra = tuple(sorted(
+                (k, str(v)) for k, v in eqn.params.items()
+                if k in ("perm", "all_gather_dimension", "tiled",
+                         "axis_index_groups", "split_axis", "concat_axis")))
+            sig.append((name, _axes_of(eqn), operands, extra))
+        else:
+            for sub in _sub_jaxprs_of(eqn):
+                sig.extend(collective_signature(sub))
+    return tuple(sig)
+
+
+def pass_collective_consistency(traced: TracedGraph) -> List[Finding]:
+    """Branch-divergent collective sequences under a predicate that is not
+    provably replicated: the cross-rank deadlock/desync class. A cond whose
+    branches differ (the dense escape hatch, the consensus audit gate) is
+    legal exactly when its predicate is replicated — every rank takes the
+    same branch, so the mismatched schedules are never both live."""
+    findings: List[Finding] = []
+    var = _propagate_variance(traced.body, traced.axis_name, traced.varying)
+
+    def walk(jaxpr, local_var):
+        def lookup(v):
+            return local_var.get(v, False) if _is_var(v) else False
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "cond":
+                branches = [getattr(b, "jaxpr", b)
+                            for b in eqn.params["branches"]]
+                sigs = [collective_signature(b) for b in branches]
+                if any(s != sigs[0] for s in sigs[1:]):
+                    pred_varying = lookup(eqn.invars[0])
+                    if pred_varying:
+                        findings.append(Finding(
+                            pass_name="collective_consistency",
+                            config=traced.name, severity="error",
+                            stage=_stage_of(eqn),
+                            message=(
+                                "lax.cond branches issue different "
+                                "collective sequences "
+                                f"({[len(s) for s in sigs]} collectives per "
+                                "branch) and the predicate is derived from "
+                                "rank-varying data — ranks can take "
+                                "different branches and deadlock/desync at "
+                                "the first mismatched collective"),
+                            details=(("world", traced.world),)))
+            elif name == "while":
+                cond_j = getattr(eqn.params.get("cond_jaxpr"), "jaxpr",
+                                 eqn.params.get("cond_jaxpr"))
+                body_j = getattr(eqn.params.get("body_jaxpr"), "jaxpr",
+                                 eqn.params.get("body_jaxpr"))
+                n_coll = (len(collective_signature(body_j))
+                          if body_j is not None else 0)
+                n_coll += (len(collective_signature(cond_j))
+                           if cond_j is not None else 0)
+                if n_coll and any(lookup(v) for v in eqn.invars):
+                    findings.append(Finding(
+                        pass_name="collective_consistency",
+                        config=traced.name, severity="error",
+                        stage=_stage_of(eqn),
+                        message=(
+                            f"while loop contains {n_coll} collective(s) "
+                            "but its carry includes rank-varying data — "
+                            "trip counts can diverge across ranks and "
+                            "strand a subset in the collective"),
+                        details=(("world", traced.world),)))
+            # Recurse with operand variance mapped into the sub-jaxpr.
+            for sub in _sub_jaxprs_of(eqn):
+                ops = eqn.invars[1:] if name == "cond" else eqn.invars
+                if len(sub.invars) == len(ops):
+                    seed = {sv: lookup(ov)
+                            for sv, ov in zip(sub.invars, ops)}
+                else:
+                    seed = {sv: True for sv in sub.invars}
+                walk(sub, _propagate_variance(sub, traced.axis_name, seed))
+
+    walk(traced.body, var)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 2: bit-exactness of cross-replica reductions
+# ---------------------------------------------------------------------------
+
+def pass_bit_exactness(traced: TracedGraph) -> List[Finding]:
+    """Bit-pattern data must never ride a float-space cross-replica
+    reduction (the PR-3 bug class: ``-0.0 + 0.0 == +0.0`` flips sign bits,
+    NaN payloads are not preserved through float adds).
+
+    Taint: values whose *numeric content encodes a bit pattern* — produced
+    by ``bitcast_convert_type`` to an integer dtype (fingerprint words,
+    checksum folds, masked-broadcast words), propagated through arithmetic
+    and value conversions, and cleared by a bitcast back to float (which
+    reconstructs the original values). A float-dtype
+    ``psum``/``pmean``/... over tainted data is the finding; integer-space
+    reductions (``masked_broadcast``'s uint psum) and gathers (which move
+    bits verbatim) are exactly the sanctioned alternatives.
+    """
+    findings: List[Finding] = []
+
+    def walk(jaxpr, seed_taint: Dict[Any, bool]):
+        taint: Dict[Any, bool] = {}
+        for v in jaxpr.invars:
+            taint[v] = seed_taint.get(v, False)
+        for v in jaxpr.constvars:
+            taint[v] = False
+
+        def lookup(v):
+            return taint.get(v, False) if _is_var(v) else False
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            any_in = any(lookup(v) for v in eqn.invars)
+            if name == "bitcast_convert_type":
+                new_dtype = np.dtype(eqn.params["new_dtype"])
+                out = not np.issubdtype(new_dtype, np.floating)
+            elif name in _REDUCTIONS:
+                if (traced.axis_name in _axes_of(eqn) and any(
+                        lookup(v) and np.issubdtype(v.aval.dtype,
+                                                    np.floating)
+                        for v in eqn.invars if _is_var(v))):
+                    findings.append(Finding(
+                        pass_name="bit_exactness",
+                        config=traced.name, severity="error",
+                        stage=_stage_of(eqn),
+                        message=(
+                            f"float-dtype {name} over bit-pattern data "
+                            "(descends from an integer bitcast: "
+                            "fingerprint/checksum/masked-broadcast words) "
+                            "— float adds alias -0.0/+0.0 and drop NaN "
+                            "payloads; reduce in integer bit space "
+                            "(comm.masked_broadcast) instead"),
+                        details=(("world", traced.world),)))
+                out = any_in
+            else:
+                subs = _sub_jaxprs_of(eqn)
+                for sub in subs:
+                    ops = eqn.invars[1:] if name == "cond" else eqn.invars
+                    if len(sub.invars) == len(ops):
+                        walk(sub, {sv: lookup(ov)
+                                   for sv, ov in zip(sub.invars, ops)})
+                    else:
+                        walk(sub, {sv: any_in for sv in sub.invars})
+                out = any_in
+            for v in eqn.outvars:
+                taint[v] = out
+
+    walk(traced.body, {})
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 3: wire-byte reconciliation against Communicator.recv_wire_bytes
+# ---------------------------------------------------------------------------
+
+def count_recv_bytes(jaxpr, axis_name: str, world: int) -> int:
+    """Logical bytes RECEIVED per rank for the collectives in ``jaxpr``
+    (recursive; cond branches count as the max across branches — an upper
+    bound matching how the wire model prices the live path).
+
+    Per-collective accounting mirrors the standard schedules the model in
+    :meth:`grace_tpu.core.Communicator.recv_wire_bytes` assumes: ring
+    all-reduce moves ``2·n·(W-1)/W``; a gather receives every other rank's
+    shard ``n·(W-1)``; a ppermute hop receives one full operand; all_to_all
+    and reduce_scatter receive ``n·(W-1)/W``.
+    """
+    total = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS and axis_name in _axes_of(eqn):
+            nbytes = sum(_aval_nbytes(v.aval) for v in eqn.invars
+                         if _is_var(v))
+            if name in _REDUCTIONS:
+                total += 2 * nbytes * (world - 1) // max(1, world)
+            elif name in _GATHERS:
+                total += nbytes * max(0, world - 1)
+            elif name in _PERMUTES:
+                total += nbytes
+            else:                      # all_to_all / reduce_scatter
+                total += nbytes * (world - 1) // max(1, world)
+        elif name == "cond":
+            total += max((count_recv_bytes(getattr(b, "jaxpr", b),
+                                           axis_name, world)
+                          for b in eqn.params["branches"]), default=0)
+        else:
+            for sub in _sub_jaxprs_of(eqn):
+                total += count_recv_bytes(sub, axis_name, world)
+    return total
+
+
+def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
+    """Count the traced graph's per-rank received collective bytes and
+    reconcile them against the ``Communicator.recv_wire_bytes`` model that
+    telemetry rows and bench projections trust. Fails when the
+    hand-maintained model drifts from the real collective schedule by more
+    than the documented tolerance (:data:`grace_tpu.core.WIRE_MODEL_RTOL` /
+    ``WIRE_MODEL_ATOL``). Needs ``meta['grace']`` (the config bundle) — a
+    no-op on traces without a priceable model."""
+    from grace_tpu.core import WIRE_MODEL_ATOL, WIRE_MODEL_RTOL
+    from grace_tpu.transform import fusion_payload_nbytes
+    from grace_tpu.analysis.trace import default_param_structs
+
+    grace = traced.meta.get("grace")
+    if grace is None:
+        return []
+    leaves = traced.meta.get("param_structs")
+    if leaves is None:
+        leaves = list(default_param_structs().values())
+    else:
+        import jax
+        leaves = jax.tree_util.tree_leaves(leaves)
+
+    counted = count_recv_bytes(traced.body, traced.axis_name, traced.world)
+    _, comp_b, n_elems = fusion_payload_nbytes(
+        grace.compressor, leaves, grace.fusion)
+    vote = bool(getattr(grace.compressor, "vote_aggregate", False))
+    model = grace.communicator.recv_wire_bytes(comp_b, n_elems,
+                                               traced.world, vote=vote)
+    tol = max(WIRE_MODEL_RTOL * max(model, counted), WIRE_MODEL_ATOL)
+    if abs(counted - model) > tol:
+        return [Finding(
+            pass_name="wire_reconciliation", config=traced.name,
+            severity="error", stage="grace/exchange",
+            message=(
+                f"{type(grace.communicator).__name__}.recv_wire_bytes "
+                f"models {model} B/rank/step but the traced graph moves "
+                f"{counted} B (world={traced.world}, payload={comp_b} B) — "
+                f"drift {abs(counted - model)} B exceeds the documented "
+                f"tolerance (rtol={WIRE_MODEL_RTOL}, "
+                f"atol={WIRE_MODEL_ATOL} B); telemetry wire_bytes and "
+                "bench projections are lying"),
+            details=(("model_bytes", int(model)),
+                     ("counted_bytes", int(counted)),
+                     ("world", traced.world)))]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# pass 4: retrace / host-sync sniffing
+# ---------------------------------------------------------------------------
+
+def _aval_sig(aval) -> Tuple:
+    return (tuple(aval.shape), str(aval.dtype),
+            bool(getattr(aval, "weak_type", False)))
+
+
+def pass_signature_stability(traced: TracedGraph) -> List[Finding]:
+    """Two retrace/host-sync smells that turn a compiled step into a
+    per-step recompile or a device round-trip:
+
+    * the abstract state signature must be a **fixed point** of the update
+      — a weak-type promotion or Python-scalar closure leak (``count +
+      1.0``) changes the next step's input avals, forcing jit to retrace
+      every step (and silently duplicating compile memory);
+    * host callbacks (``io_callback``/``debug_callback``/``pure_callback``)
+      inside the compiled step serialize the device against the host —
+      telemetry exists precisely so the hot path never does this.
+    """
+    findings: List[Finding] = []
+    for (path, in_aval), (_, out_aval) in zip(traced.state_in,
+                                              traced.state_out):
+        if _aval_sig(in_aval) != _aval_sig(out_aval):
+            si, so = _aval_sig(in_aval), _aval_sig(out_aval)
+            what = ("weak-type promotion"
+                    if si[:2] == so[:2] and si[2] != so[2]
+                    else "abstract-signature change")
+            findings.append(Finding(
+                pass_name="signature_stability", config=traced.name,
+                severity="error",
+                message=(
+                    f"state leaf '{path}' is not a signature fixed point: "
+                    f"in {si[0]}/{si[1]}"
+                    f"{'/weak' if si[2] else ''} -> out {so[0]}/{so[1]}"
+                    f"{'/weak' if so[2] else ''} ({what} — likely a Python "
+                    "scalar leaking into the carried state; jit retraces "
+                    "every step)"),
+                details=(("path", path),)))
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in _CALLBACK_PRIMS:
+                cb = eqn.params.get("callback", "")
+                findings.append(Finding(
+                    pass_name="signature_stability", config=traced.name,
+                    severity="error", stage=_stage_of(eqn),
+                    message=(
+                        f"host callback '{name}' inside the compiled step "
+                        f"({cb!r}) — serializes every step against the "
+                        "host; use the in-graph telemetry ring "
+                        "(grace_tpu.telemetry) and drain it at flush "
+                        "boundaries instead"),
+                    details=()))
+            for sub in _sub_jaxprs_of(eqn):
+                walk(sub)
+
+    walk(traced.body)
+    return findings
+
+
+_PASS_FNS = {
+    "collective_consistency": pass_collective_consistency,
+    "bit_exactness": pass_bit_exactness,
+    "wire_reconciliation": pass_wire_reconciliation,
+    "signature_stability": pass_signature_stability,
+}
+
+
+def run_passes(traced: TracedGraph,
+               passes: Optional[Tuple[str, ...]] = None) -> List[Finding]:
+    """Run the named passes (default: all four) over one traced graph."""
+    out: List[Finding] = []
+    for name in (passes if passes is not None else PASS_NAMES):
+        out.extend(_PASS_FNS[name](traced))
+    return out

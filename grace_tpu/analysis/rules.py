@@ -1,0 +1,260 @@
+"""AST-level repo rules: invariants the type system can't hold for us.
+
+Unlike the jaxpr passes (which audit *traced behavior*), these rules audit
+*source*: contracts every new contribution must state explicitly, enforced
+forever instead of living in review-comment folklore.
+
+* ``compressor-capabilities`` — every ``Compressor`` subclass must declare
+  ``summable_payload`` and ``supports_hop_requant`` in its own class body.
+  These two flags are the communicator compatibility matrix
+  (``Allreduce``/``RingAllreduce`` gate on them); an inherited implicit
+  ``False`` is *probably* right but silently wrong for a new linear codec,
+  and the declaration is the author's signed statement either way.
+* ``telemetry-fields-reducer`` — every ``FIELDS`` entry in
+  ``telemetry/state.py`` must name a host-side reducer from the known set;
+  the reader aggregates flush bundles by that string and an unknown one
+  becomes a silent mis-aggregation.
+* ``pytest-marker-registration`` — every ``pytest.mark.<name>`` used under
+  ``tests/``/``tools/`` must be registered in ``pyproject.toml`` (pytest
+  only warns on unknown markers, so a typo'd marker silently drops tests
+  from ``-m`` selections).
+
+``run_repo_rules(sources=...)`` accepts an in-memory ``{relpath: source}``
+override so the seeded-bad-source tests can prove each rule fires without
+touching the working tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional
+
+from grace_tpu.analysis.passes import Finding
+
+__all__ = ["RULE_NAMES", "run_repo_rules", "repo_root",
+           "registered_markers"]
+
+RULE_NAMES = ("compressor-capabilities", "telemetry-fields-reducer",
+              "pytest-marker-registration")
+
+_REQUIRED_CAPS = ("summable_payload", "supports_hop_requant")
+_KNOWN_REDUCERS = {"first", "mean", "max", "min", "sum"}
+# Markers pytest ships (or plugins this repo uses) — never need registering.
+_BUILTIN_MARKS = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+                  "filterwarnings", "timeout", "tryfirst", "trylast",
+                  "no_cover", "anyio", "asyncio"}
+
+
+def repo_root() -> str:
+    """The repo checkout: parent of the installed grace_tpu package."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(here)
+
+
+def _read(root: str, rel: str,
+          sources: Optional[Dict[str, str]]) -> Optional[str]:
+    if sources is not None and rel in sources:
+        return sources[rel]
+    path = os.path.join(root, rel)
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _iter_py(root: str, reldir: str,
+             sources: Optional[Dict[str, str]]) -> List[str]:
+    """Relative paths of .py files under ``reldir`` (plus any in-memory
+    overrides living there)."""
+    rels = []
+    absdir = os.path.join(root, reldir)
+    if os.path.isdir(absdir):
+        for dirpath, _dirs, files in os.walk(absdir):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    rels.append(os.path.relpath(os.path.join(dirpath, fn),
+                                                root))
+    if sources is not None:
+        for rel in sources:
+            if rel.startswith(reldir) and rel.endswith(".py") \
+                    and rel not in rels:
+                rels.append(rel)
+    return rels
+
+
+def _class_assigns(cls: ast.ClassDef) -> set:
+    names = set()
+    for node in cls.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+def _base_names(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return out
+
+
+def rule_compressor_capabilities(root: str, sources=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in _iter_py(root, os.path.join("grace_tpu", "compressors"),
+                        sources):
+        src = _read(root, rel, sources)
+        if src is None:
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(Finding(
+                pass_name="compressor-capabilities", config=rel,
+                severity="error", message=f"unparseable source: {e}"))
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not any(b.endswith("Compressor") for b in _base_names(node)):
+                continue
+            missing = [c for c in _REQUIRED_CAPS
+                       if c not in _class_assigns(node)]
+            if missing:
+                findings.append(Finding(
+                    pass_name="compressor-capabilities",
+                    config=f"{rel}:{node.lineno}", severity="error",
+                    message=(
+                        f"{node.name} does not declare "
+                        f"{'/'.join(missing)} in its class body — these "
+                        "flags ARE the communicator compatibility matrix "
+                        "(Allreduce payload-space summation, RingAllreduce "
+                        "per-hop requantization); state them explicitly "
+                        "even when False so the contract is visible at "
+                        "the definition site"),
+                    details=(("class", node.name),)))
+    return findings
+
+
+def rule_telemetry_fields(root: str, sources=None) -> List[Finding]:
+    rel = os.path.join("grace_tpu", "telemetry", "state.py")
+    src = _read(root, rel, sources)
+    if src is None:
+        return [Finding(pass_name="telemetry-fields-reducer", config=rel,
+                        severity="error", message="state.py not found")]
+    findings: List[Finding] = []
+    tree = ast.parse(src)
+    fields_node = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "FIELDS":
+                    fields_node = node.value
+    if fields_node is None or not isinstance(fields_node,
+                                             (ast.Tuple, ast.List)):
+        return [Finding(pass_name="telemetry-fields-reducer", config=rel,
+                        severity="error",
+                        message="FIELDS tuple literal not found")]
+    for i, elt in enumerate(fields_node.elts):
+        ok = (isinstance(elt, ast.Tuple) and len(elt.elts) == 2
+              and all(isinstance(e, ast.Constant)
+                      and isinstance(e.value, str) for e in elt.elts))
+        if not ok:
+            findings.append(Finding(
+                pass_name="telemetry-fields-reducer",
+                config=f"{rel}:{elt.lineno}", severity="error",
+                message=(f"FIELDS[{i}] is not a (name, reducer) string "
+                         "pair — the reader aggregates flush bundles by "
+                         "the reducer string")))
+            continue
+        name, reducer = (e.value for e in elt.elts)
+        if reducer not in _KNOWN_REDUCERS:
+            findings.append(Finding(
+                pass_name="telemetry-fields-reducer",
+                config=f"{rel}:{elt.lineno}", severity="error",
+                message=(f"FIELDS entry {name!r} names unknown reducer "
+                         f"{reducer!r} (known: "
+                         f"{sorted(_KNOWN_REDUCERS)}) — the host-side "
+                         "cross-rank aggregation would silently fall "
+                         "through")))
+    return findings
+
+
+def registered_markers(root: str, sources=None) -> set:
+    """Marker names registered in pyproject.toml (minimal TOML-free parse:
+    the quoted strings of the ``markers = [...]`` array, first word before
+    the colon)."""
+    src = _read(root, "pyproject.toml", sources)
+    if src is None:
+        return set()
+    m = re.search(r"markers\s*=\s*\[(.*?)\]", src, re.DOTALL)
+    if not m:
+        return set()
+    names = set()
+    for entry in re.findall(r"[\"']([^\"']+)[\"']", m.group(1)):
+        names.add(entry.split(":")[0].strip())
+    return names
+
+
+def rule_pytest_markers(root: str, sources=None) -> List[Finding]:
+    registered = registered_markers(root, sources) | _BUILTIN_MARKS
+    findings: List[Finding] = []
+    for reldir in ("tests", "tools"):
+        for rel in _iter_py(root, reldir, sources):
+            src = _read(root, rel, sources)
+            if src is None:
+                continue
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                # pytest.mark.<name> — attribute chain rooted at pytest.
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Attribute)
+                        and node.value.attr == "mark"
+                        and isinstance(node.value.value, ast.Name)
+                        and node.value.value.id == "pytest"):
+                    name = node.attr
+                    if name not in registered:
+                        findings.append(Finding(
+                            pass_name="pytest-marker-registration",
+                            config=f"{rel}:{node.lineno}",
+                            severity="error",
+                            message=(
+                                f"pytest marker {name!r} is not "
+                                "registered in pyproject.toml "
+                                "[tool.pytest.ini_options] markers — "
+                                "pytest only warns on unknown markers, so "
+                                f"'-m {name}' selections silently go "
+                                "empty on a typo"),
+                            details=(("marker", name),)))
+    return findings
+
+
+_RULE_FNS = {
+    "compressor-capabilities": rule_compressor_capabilities,
+    "telemetry-fields-reducer": rule_telemetry_fields,
+    "pytest-marker-registration": rule_pytest_markers,
+}
+
+
+def run_repo_rules(root: Optional[str] = None, *,
+                   rules=None,
+                   sources: Optional[Dict[str, str]] = None
+                   ) -> List[Finding]:
+    """Run the named AST rules (default: all) over the repo at ``root``."""
+    root = root or repo_root()
+    out: List[Finding] = []
+    for name in (rules if rules is not None else RULE_NAMES):
+        out.extend(_RULE_FNS[name](root, sources))
+    return out

@@ -1,0 +1,306 @@
+"""Device-free SPMD tracing: any grace config to a jaxpr on a CPU in CI.
+
+The insight making static auditing possible: ``jax.shard_map`` accepts an
+``AbstractMesh`` — a mesh of *names and sizes* with no devices behind it —
+and ``jax.make_jaxpr`` happily traces through it. So the full compressed
+pipeline (compress, collectives, error feedback, escape cond, consensus
+audit) lowers to an inspectable jaxpr at world size W on a machine with one
+CPU core and zero TPUs. Collectives appear as first-class equations
+(``psum``/``all_gather``/``ppermute``/``all_to_all``), conds carry their
+branch jaxprs, and ``jax.named_scope`` stage names from
+:mod:`grace_tpu.telemetry.scopes` ride along in each equation's
+``source_info.name_stack`` — which is how findings name the offending
+pipeline stage.
+
+Rank-variance seeding: inside ``shard_map`` every value is per-device, but
+only *some* carry rank-varying data (gradients from the sharded batch,
+GraceState mem/comp residuals, telemetry rings); the rest are replicated by
+contract (step count, rng key, fallback flag, params). The tracer derives
+the seed mask from :func:`grace_tpu.transform.partition_specs` — the same
+source of truth the real train step shards state with — so the passes'
+replication analysis starts from the layout the system actually promises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from grace_tpu.core import DEFAULT_AXIS
+from grace_tpu.parallel import shard_map
+from grace_tpu.transform import partition_specs
+
+__all__ = ["TracedGraph", "abstract_mesh", "default_param_structs",
+           "trace_fn", "trace_update", "trace_train_step"]
+
+# Default parameter tree for config audits. Flat size 512 = 8 * 64: evenly
+# shardable over the 8-way audit mesh with shard sizes divisible by 8, so
+# bit-packing codecs (signsgd's 8-signs-per-byte) cost the same whether
+# packed per shard or whole — keeping the wire-byte reconciliation pass
+# free of pure test-shape rounding noise (real gradients are megabytes;
+# ceil-rounding on 17-element shards is not a model drift worth flagging).
+_DEFAULT_PARAMS = (("w", (60, 8)), ("b", (32,)))
+
+
+def abstract_mesh(world: int, axis_name: str = DEFAULT_AXIS):
+    """An ``AbstractMesh`` across JAX versions (0.4.37 takes one
+    ``((name, size), ...)`` tuple; newer releases take separate shape and
+    axis-name tuples)."""
+    from jax.sharding import AbstractMesh
+
+    try:
+        return AbstractMesh(((axis_name, world),))
+    except (TypeError, ValueError):
+        return AbstractMesh((world,), (axis_name,))
+
+
+def default_param_structs() -> Dict[str, jax.ShapeDtypeStruct]:
+    return {name: jax.ShapeDtypeStruct(shape, jnp.float32)
+            for name, shape in _DEFAULT_PARAMS}
+
+
+@dataclasses.dataclass
+class TracedGraph:
+    """One audited program: the shard_map body jaxpr plus audit context.
+
+    ``varying`` maps each body input var to whether it carries rank-varying
+    data (the replication-analysis seed). ``state_in``/``state_out`` are
+    aligned (path, aval) lists for the optimizer-state portion of the
+    signature — the fixed-point check of ``signature_stability``. ``meta``
+    carries whatever the config registry wants findings to report
+    (compressor/communicator names, the Grace bundle for the wire model).
+    """
+
+    name: str
+    closed: Any                      # ClosedJaxpr of the whole traced fn
+    body: Any                        # the shard_map body Jaxpr
+    world: int
+    axis_name: str
+    varying: Dict[Any, bool]
+    state_in: List[Tuple[str, Any]] = dataclasses.field(default_factory=list)
+    state_out: List[Tuple[str, Any]] = dataclasses.field(default_factory=list)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _find_shard_map_body(jaxpr):
+    """Depth-first search for the first shard_map equation's body jaxpr."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "shard_map":
+            body = eqn.params["jaxpr"]
+            return getattr(body, "jaxpr", body)
+        for sub in _sub_jaxprs(eqn):
+            found = _find_shard_map_body(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def _sub_jaxprs(eqn):
+    """Every jaxpr nested in an equation's params (cond branches, pjit
+    bodies, scan/while jaxprs, custom_*_call), normalized to raw Jaxprs."""
+    out = []
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vs:
+            inner = getattr(item, "jaxpr", item)
+            if hasattr(inner, "eqns") and hasattr(inner, "invars"):
+                out.append(inner)
+    return out
+
+
+def _spec_mentions(spec, axis_name: str) -> bool:
+    if spec is None:
+        return False
+    for entry in spec:
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        if axis_name in names:
+            return True
+    return False
+
+
+def _flat_paths(tree) -> List[str]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, _leaf in flat:
+        parts = []
+        for e in path:
+            for attr in ("name", "key", "idx"):
+                if hasattr(e, attr):
+                    parts.append(str(getattr(e, attr)))
+                    break
+            else:
+                parts.append(str(e))
+        out.append("/".join(parts))
+    return out
+
+
+def _varying_mask_from_specs(state_struct, axis_name: str) -> List[bool]:
+    """Per-leaf rank-variance of a state pytree, derived from the same
+    ``partition_specs`` the real train step shards it with: leaves whose
+    spec mentions the mesh axis (GraceState mem/comp/telem) vary per rank;
+    everything else is replicated by the system's own sharding contract."""
+    specs = partition_specs(state_struct, axis_name)
+    flat_specs = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    flat_state = jax.tree_util.tree_leaves(state_struct)
+    if len(flat_specs) != len(flat_state):      # structure drifted — be safe
+        return [True] * len(flat_state)
+    return [_spec_mentions(s, axis_name) for s in flat_specs]
+
+
+def trace_fn(fn, args: Sequence[Any], *, world: int = 8,
+             axis_name: str = DEFAULT_AXIS,
+             varying: Optional[Sequence[bool]] = None,
+             name: str = "fn", meta: Optional[dict] = None) -> TracedGraph:
+    """Trace an arbitrary function inside an AbstractMesh shard_map.
+
+    ``args`` are ShapeDtypeStructs (or arrays) handed to the body
+    per-device; ``varying`` flags each *flattened leaf* of ``args`` as
+    rank-varying (default: all varying — conservative). This is the
+    low-level entry the seeded-bad-graph tests use; config audits go
+    through :func:`trace_update` / :func:`trace_train_step`.
+    """
+    am = abstract_mesh(world, axis_name)
+    n_args = len(args)
+    sm = shard_map(lambda *a: fn(*a), mesh=am,
+                   in_specs=tuple(P() for _ in range(n_args)),
+                   out_specs=P(), check_vma=False)
+    closed = jax.make_jaxpr(sm)(*args)
+    body = _find_shard_map_body(closed.jaxpr)
+    if body is None:
+        raise ValueError("no shard_map equation found in the traced jaxpr")
+    flat = jax.tree_util.tree_leaves(tuple(args))
+    mask = list(varying) if varying is not None else [True] * len(flat)
+    if len(mask) != len(flat):
+        raise ValueError(f"varying mask has {len(mask)} entries for "
+                         f"{len(flat)} flattened arg leaves")
+    if len(body.invars) != len(flat):           # conservative fallback
+        mask = [True] * len(body.invars)
+    var_map = dict(zip(body.invars, mask))
+    return TracedGraph(name=name, closed=closed, body=body, world=world,
+                       axis_name=axis_name, varying=var_map,
+                       meta=dict(meta or {}))
+
+
+def trace_update(grace, *, world: int = 8, params=None,
+                 name: str = "update", meta: Optional[dict] = None
+                 ) -> TracedGraph:
+    """Trace one ``grace_transform`` update (the whole 6-stage pipeline,
+    escape cond and telemetry included) at world size ``world``.
+
+    The traced body is exactly what runs inside the real train step's
+    shard_map: per-device state in, per-device gradients in, aggregated
+    updates and next state out. No devices are touched — state comes from
+    ``jax.eval_shape`` over ``init``.
+    """
+    axis_name = grace.communicator.axis_name
+    tx = grace.transform(seed=0)
+    params = params if params is not None else default_param_structs()
+    state_struct = jax.eval_shape(tx.init, params)
+    grads_struct = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params)
+
+    def body(state, grads):
+        updates, new_state = tx.update(grads, state, None)
+        return updates, new_state
+
+    am = abstract_mesh(world, axis_name)
+    sm = shard_map(body, mesh=am, in_specs=(P(), P()),
+                   out_specs=(P(), P()), check_vma=False)
+    closed = jax.make_jaxpr(sm)(state_struct, grads_struct)
+    inner = _find_shard_map_body(closed.jaxpr)
+    if inner is None:
+        raise ValueError("no shard_map equation found in the traced update")
+
+    state_flat = jax.tree_util.tree_leaves(state_struct)
+    grads_flat = jax.tree_util.tree_leaves(grads_struct)
+    mask = (_varying_mask_from_specs(state_struct, axis_name)
+            + [True] * len(grads_flat))
+    if len(inner.invars) != len(state_flat) + len(grads_flat):
+        mask = [True] * len(inner.invars)
+        state_in = []
+    else:
+        paths = _flat_paths(state_struct)
+        state_in = [(p, inner.invars[i].aval)
+                    for i, p in enumerate(paths)]
+    var_map = dict(zip(inner.invars, mask))
+
+    # Body outputs are (updates..., new_state...): the state signature the
+    # next step re-traces against is the trailing slice.
+    n_state = len(state_flat)
+    state_out = []
+    if state_in and len(inner.outvars) >= n_state:
+        out_tail = inner.outvars[len(inner.outvars) - n_state:]
+        state_out = [(p, v.aval)
+                     for (p, _), v in zip(state_in, out_tail)]
+    return TracedGraph(name=name, closed=closed, body=inner, world=world,
+                       axis_name=axis_name, varying=var_map,
+                       state_in=state_in, state_out=state_out,
+                       meta=dict(meta or {}))
+
+
+def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
+                     consensus=None, name: str = "train_step",
+                     meta: Optional[dict] = None) -> TracedGraph:
+    """Trace a full ``make_train_step`` program (fwd/bwd, optimizer chain,
+    optional guard and consensus audit) over an AbstractMesh.
+
+    This is the graph the collective-consistency and bit-exactness passes
+    care most about: the guard's skip/rollback selects, the dense-escape
+    cond, and the consensus ``lax.cond`` audit gate with its fingerprint
+    all_gather and masked-psum repair broadcasts all appear here exactly as
+    they would on a pod.
+    """
+    from grace_tpu.train import TrainState, make_train_step
+    from grace_tpu.transform import add_world_axis
+
+    axis_name = grace.communicator.axis_name
+    params = default_param_structs()
+    dim, classes = _DEFAULT_PARAMS[0][1][0], _DEFAULT_PARAMS[0][1][1]
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = x @ p["w"] + p["b"][:classes]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    tx = optax.chain(grace.transform(seed=0), optax.sgd(0.1))
+    if guard is not None:
+        from grace_tpu.resilience import guard_transform
+        tx = guard_transform(tx, axis_name=axis_name, **guard)
+
+    am = abstract_mesh(world, axis_name)
+    abstract = jax.eval_shape(tx.init, params)
+    specs = partition_specs(abstract, axis_name)
+    init_fn = shard_map(lambda p: add_world_axis(tx.init(p)), mesh=am,
+                        in_specs=(P(),), out_specs=specs, check_vma=False)
+    opt_struct = jax.eval_shape(init_fn, params)
+    state_struct = TrainState(params=params, opt_state=opt_struct)
+    batch = (jax.ShapeDtypeStruct((world * 4, dim), jnp.float32),
+             jax.ShapeDtypeStruct((world * 4,), jnp.int32))
+
+    step = make_train_step(loss_fn, tx, mesh=am, axis_name=axis_name,
+                           donate=False, consensus=consensus)
+    closed = jax.make_jaxpr(step)(state_struct, batch)
+    inner = _find_shard_map_body(closed.jaxpr)
+    if inner is None:
+        raise ValueError("no shard_map equation found in the traced step")
+
+    state_flat = jax.tree_util.tree_leaves(state_struct)
+    batch_flat = jax.tree_util.tree_leaves(batch)
+    mask = (_varying_mask_from_specs(state_struct, axis_name)
+            + [True] * len(batch_flat))
+    if len(inner.invars) != len(state_flat) + len(batch_flat):
+        mask = [True] * len(inner.invars)
+    var_map = dict(zip(inner.invars, mask))
+    return TracedGraph(name=name, closed=closed, body=inner, world=world,
+                       axis_name=axis_name, varying=var_map,
+                       meta=dict(meta or {}))

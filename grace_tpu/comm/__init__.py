@@ -96,6 +96,13 @@ def masked_broadcast(x: jax.Array, root, axis_name: str) -> jax.Array:
 
     ``root`` may be a static int or a traced (replicated) scalar. Must be
     called where ``axis_name`` is bound (inside ``shard_map``/``pjit``).
+
+    This integer-bit-space idiom is now *enforced repo-wide*: the static
+    auditor's bit-exactness pass (:mod:`grace_tpu.analysis`,
+    ``tools/graft_lint.py``) taint-tracks bitcast products through every
+    registered config's jaxpr and fails CI on any float-space
+    cross-replica reduction over them — re-introducing the PR-3 bug class
+    is a lint error, not a code-review catch.
     """
     x = jnp.asarray(x)
     i = lax.axis_index(axis_name)

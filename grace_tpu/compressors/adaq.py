@@ -29,6 +29,10 @@ from grace_tpu.ops.packing import pack_bits, unpack_bits
 @dataclasses.dataclass(frozen=True)
 class AdaqCompressor(Compressor):
     tensors_size_are_same = False
+    # Per-rank group means over per-rank selections: payloads decode
+    # against rank-local structure a sum (or partial sum) destroys.
+    summable_payload = False
+    supports_hop_requant = False
 
     compress_ratio: float = 0.01
     sample_ratio: float = 0.01

@@ -26,6 +26,11 @@ from grace_tpu.ops.sparse import scatter_dense
 @dataclasses.dataclass(frozen=True)
 class DgcCompressor(Compressor):
     tensors_size_are_same = False
+    # Capacity-masked (values, per-rank indices): summing payloads mixes
+    # entries at different coordinates, and a partial sum destroys the
+    # sampled-threshold capacity mask a re-encode would need.
+    summable_payload = False
+    supports_hop_requant = False
 
     compress_ratio: float = 0.01
     sample_ratio: float = 0.01

@@ -21,6 +21,10 @@ from grace_tpu.ops.packing import pack_bits, unpack_bits
 @dataclasses.dataclass(frozen=True)
 class EFSignSGDCompressor(Compressor):
     average = False
+    # Payload is (packed signs, per-rank 1/lr·mean scale): sign bytes don't
+    # sum and the scale pair has no meaning over a partial sum.
+    summable_payload = False
+    supports_hop_requant = False
 
     lr: float = 0.1
 

@@ -20,6 +20,8 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 class FP16Compressor(Compressor):
     dtype: str = "bfloat16"
     summable_payload = True
+    # Linear codec: the exact payload-space ring path applies; no requant.
+    supports_hop_requant = False
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

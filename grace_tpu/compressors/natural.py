@@ -28,6 +28,11 @@ _MAX_BIASED_EXP = 145  # reference clip: 0b01001000100... = 145 << 23
 
 @dataclasses.dataclass(frozen=True)
 class NaturalCompressor(Compressor):
+    # Integer exponent/sign codes: adding two ranks' code words is garbage,
+    # and there is no bounded re-encode of a partial sum.
+    summable_payload = False
+    supports_hop_requant = False
+
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
         shape = x.shape

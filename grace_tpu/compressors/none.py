@@ -21,6 +21,9 @@ class NoneCompressor(Compressor):
 
     average: bool = True
     summable_payload = True
+    # Linear codec: the exact payload-space ring path applies; a requant
+    # round-trip would add nothing but work.
+    supports_hop_requant = False
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

@@ -20,6 +20,11 @@ from grace_tpu.ops.packing import pack_bits, unpack_bits
 
 @dataclasses.dataclass(frozen=True)
 class OneBitCompressor(Compressor):
+    # Payload is (packed sign mask, mean-of-negatives, mean-of-positives):
+    # the mean pair has no meaning summed across ranks or over a partial.
+    summable_payload = False
+    supports_hop_requant = False
+
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
         shape, numel = x.shape, x.size

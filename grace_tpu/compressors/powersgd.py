@@ -55,6 +55,9 @@ class PowerSGDCompressor(Compressor):
     # psum-reduced inside compress, so the outer allreduce sees a replicated
     # payload that sums/averages consistently.
     summable_payload = True
+    # Communicates inside compress and carries cross-step Q state — the
+    # shard-parallel communicators reject it before capability gating.
+    supports_hop_requant = False
 
     def _factor_shapes(self, shape):
         m = shape[-1]              # output-channel dim (HWIO/(*, features))

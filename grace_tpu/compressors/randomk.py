@@ -28,6 +28,8 @@ class RandomKCompressor(Compressor):
     # Indices come from a shared fold_in key, so every rank selects the same
     # entries and payload values sum meaningfully (reference randomk.py:26-29).
     summable_payload = True
+    # Linear codec: the exact payload-space ring path applies; no requant.
+    supports_hop_requant = False
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:

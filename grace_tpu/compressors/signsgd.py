@@ -35,6 +35,9 @@ class SignSGDCompressor(Compressor):
     # exact fixed-cost vote remains SignAllreduce. (Signum inherits the
     # flag but is stateful, so the ring's stateless gate rejects it first.)
     supports_hop_requant = True
+    # Packed sign bytes: psumming them is garbage — the vote routes exist
+    # precisely because the payload is not summable.
+    summable_payload = False
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
@@ -64,6 +67,13 @@ class SignumCompressor(SignSGDCompressor):
     ``(m, initialized)`` so it jits and checkpoints. First step transmits the
     raw gradient's sign (reference: ``if name in self.momentums`` miss path).
     """
+
+    # Restated (not just inherited) per the graft-lint capability rule:
+    # stateful momentum makes the shard-parallel communicators reject
+    # Signum at the stateless gate, so it must not advertise hop requant
+    # it can never use; sign bytes are as unsummable as the parent's.
+    summable_payload = False
+    supports_hop_requant = False
 
     momentum: float = 0.9
 

@@ -19,6 +19,11 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 
 @dataclasses.dataclass(frozen=True)
 class SketchCompressor(Compressor):
+    # Bin indices against per-rank quantile edges: neither summable nor
+    # re-encodable over a partial sum (the bins themselves shift).
+    summable_payload = False
+    supports_hop_requant = False
+
     bins: int = 64
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array

@@ -34,6 +34,11 @@ from grace_tpu.ops.sparse import scatter_dense
 @dataclasses.dataclass(frozen=True)
 class ThresholdCompressor(Compressor):
     tensors_size_are_same = False
+    # (values, per-rank indices) under a capacity mask: sums mix
+    # coordinates, and the τ-mask of a partial sum is not a re-encode of
+    # the members' masks.
+    summable_payload = False
+    supports_hop_requant = False
 
     threshold: float = 0.01
     capacity_ratio: float = 0.25

@@ -47,6 +47,9 @@ class TopKCompressor(Compressor):
     # compete with the new contribution, and dropped mass is bounded by the
     # per-hop selection error. Sound for any selection algorithm here.
     supports_hop_requant = True
+    # Per-rank index sets: summing payloads adds values belonging to
+    # different coordinates (the reference's silent topk+Allreduce bug).
+    summable_payload = False
 
     compress_ratio: float = 0.3
     algorithm: str = "exact"      # 'exact' | 'approx' | 'chunk'

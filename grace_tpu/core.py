@@ -50,6 +50,16 @@ State = Any
 
 DEFAULT_AXIS = "data"
 
+# Tolerance contract of the Communicator.recv_wire_bytes model, enforced by
+# the static auditor's wire-byte reconciliation pass (grace_tpu.analysis):
+# the model must agree with the bytes counted from the actually-traced
+# collective schedule within rtol (covers per-shard rounding: ceil'd
+# bit-packing, per-shard top-k counts, per-chunk scalar norms) plus a small
+# atol floor for scalar/bookkeeping collectives. Widening these to make a
+# drifted model "pass" defeats the audit — fix the model instead.
+WIRE_MODEL_RTOL = 0.10
+WIRE_MODEL_ATOL = 256
+
 
 def axis_size(axis_name) -> int:
     """Static size of a bound mesh axis, across JAX versions.
@@ -212,6 +222,13 @@ class Communicator:
         blind and cannot rank e.g. ring/two-shot's O(k) against allgather's
         O(W·k). Default: gather-style, every other rank's payload arrives
         (``Allgather``/``Broadcast``); reduce-style subclasses override.
+
+        This model is *audited*: the static analyzer
+        (:mod:`grace_tpu.analysis`, ``tools/graft_lint.py``) counts the
+        received bytes of the actually-traced collective schedule and
+        fails CI when the model drifts beyond ``WIRE_MODEL_RTOL`` /
+        ``WIRE_MODEL_ATOL`` — an override that stops matching its
+        ``exchange``/``step`` is a lint error, not a silent telemetry lie.
         """
         return payload_nbytes * max(0, world - 1)
 

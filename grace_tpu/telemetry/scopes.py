@@ -24,7 +24,7 @@ from typing import Iterator
 
 import jax
 
-__all__ = ["trace_stage", "STAGE_COMPENSATE", "STAGE_COMPRESS",
+__all__ = ["trace_stage", "ALL_STAGES", "STAGE_COMPENSATE", "STAGE_COMPRESS",
            "STAGE_EXCHANGE", "STAGE_DECOMPRESS", "STAGE_MEMORY_UPDATE",
            "STAGE_FWD_BWD", "STAGE_OPTIMIZER", "STAGE_APPLY",
            "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS",
@@ -47,6 +47,18 @@ STAGE_CONSENSUS = "grace/consensus"
 # (ppermute + decompress + accumulate + requantize) renders as its own
 # "grace/ring_hop/<s>" span, so per-hop cost is attributable in a trace.
 STAGE_RING_HOP = "grace/ring_hop"
+
+# The canonical stage vocabulary, longest-prefix-matchable: the profiler,
+# tools/telemetry_report.py, and the static auditor's finding attribution
+# (grace_tpu.analysis — findings name the stage whose scope the offending
+# jaxpr equation was traced under) all share it. Keep sorted by length so
+# "grace/exchange/psum_vote" attributes to STAGE_EXCHANGE, not a shorter
+# accidental prefix.
+ALL_STAGES = tuple(sorted(
+    (STAGE_COMPENSATE, STAGE_COMPRESS, STAGE_EXCHANGE, STAGE_DECOMPRESS,
+     STAGE_MEMORY_UPDATE, STAGE_FWD_BWD, STAGE_OPTIMIZER, STAGE_APPLY,
+     STAGE_TELEMETRY, STAGE_DENSE_ESCAPE, STAGE_CONSENSUS, STAGE_RING_HOP),
+    key=len, reverse=True))
 
 
 @contextlib.contextmanager

@@ -225,6 +225,55 @@ def _bucketize(shapes_dtypes, bucket_bytes: Optional[int]):
     return buckets, cdtype
 
 
+def _group_views(leaves):
+    """Grouped-fusion plan: leaf-index lists keyed by (shape, dtype), in
+    first-appearance order. Deterministic in leaf order so init and update
+    always agree on group numbering."""
+    groups: dict = {}
+    for i, leaf in enumerate(leaves):
+        key = (jnp.shape(leaf), str(jnp.result_type(leaf)))
+        groups.setdefault(key, []).append(i)
+    return list(groups.values())
+
+
+def fusion_payload_nbytes(compressor: Compressor, leaves, fusion
+                          ) -> Tuple[int, int, int]:
+    """``(dense_bytes, payload_bytes, n_elems)`` for these gradient leaves
+    under a fusion setting (None | 'flat' | 'grouped' | int bucket bytes).
+
+    ``dense_bytes`` is the raw dense gradient size (the codec-blind
+    reference), ``payload_bytes`` one rank's whole-gradient wire payload
+    priced over the exact structures the fusion mode compresses, ``n_elems``
+    the dense element count. Module-level so the telemetry wire plan inside
+    :func:`grace_transform` and the static auditor's wire-byte
+    reconciliation pass (:mod:`grace_tpu.analysis`) price payloads with
+    literally the same code — drift between the priced model and the traced
+    graph is then a lint finding, never a silent disagreement.
+    """
+    from grace_tpu.utils.metrics import payload_nbytes
+
+    structs = [jax.ShapeDtypeStruct(tuple(jnp.shape(l)), jnp.result_type(l))
+               for l in leaves]
+    n_elems = sum(int(np.prod(s.shape, dtype=np.int64)) for s in structs)
+    dense = sum(int(np.prod(s.shape, dtype=np.int64)) * s.dtype.itemsize
+                for s in structs)
+    if fusion == "grouped":
+        comp_b = sum(payload_nbytes(compressor, structs[idxs[0]]) * len(idxs)
+                     for idxs in _group_views(structs))
+    elif fusion is None:
+        comp_b = sum(payload_nbytes(compressor, s) for s in structs)
+    else:
+        bucket_bytes = None if fusion == "flat" else int(fusion)
+        buckets, cdtype = _bucketize(
+            [(s.shape, s.dtype) for s in structs], bucket_bytes)
+        comp_b = sum(
+            payload_nbytes(compressor, jax.ShapeDtypeStruct(
+                (sum(int(np.prod(structs[i].shape, dtype=np.int64))
+                     for i in idxs),), jnp.dtype(cdtype)))
+            for idxs in buckets)
+    return dense, comp_b, n_elems
+
+
 def _normalize_telemetry(telemetry) -> Optional[TelemetryConfig]:
     """Accept the ergonomic spellings of the telemetry knob: None/False
     (off), True (defaults), int (ring capacity), dict (config kwargs), or a
@@ -359,16 +408,6 @@ def grace_transform(compressor: Compressor, memory: Memory,
         """Static bucketing plan for these leaves: (buckets, common dtype)."""
         return _bucketize([(jnp.shape(l), jnp.result_type(l))
                            for l in leaves], bucket_bytes)
-
-    def _group_views(leaves):
-        """Grouped-mode plan: leaf-index lists keyed by (shape, dtype), in
-        first-appearance order. Deterministic in leaf order so init and
-        update always agree on group numbering."""
-        groups: dict = {}
-        for i, leaf in enumerate(leaves):
-            key = (jnp.shape(leaf), str(jnp.result_type(leaf)))
-            groups.setdefault(key, []).append(i)
-        return list(groups.values())
 
     def init(params) -> GraceState:
         leaves = jax.tree_util.tree_leaves(params)
@@ -534,22 +573,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
             return plan
         structs = [jax.ShapeDtypeStruct(shape, jnp.dtype(d))
                    for shape, d in sig]
-        n_elems = sum(int(np.prod(s.shape, dtype=np.int64))
-                      for s in structs)
-        dense = sum(int(np.prod(s.shape, dtype=np.int64)) * s.dtype.itemsize
-                    for s in structs)
-        if grouped:
-            comp_b = sum(payload_nbytes(compressor, structs[idxs[0]])
-                         * len(idxs) for idxs in _group_views(leaves))
-        elif fused:
-            buckets, cdtype = _bucket_views(leaves)
-            comp_b = sum(
-                payload_nbytes(compressor, jax.ShapeDtypeStruct(
-                    (sum(int(np.prod(structs[i].shape, dtype=np.int64))
-                         for i in idxs),), jnp.dtype(cdtype)))
-                for idxs in buckets)
-        else:
-            comp_b = sum(payload_nbytes(compressor, s) for s in structs)
+        dense, comp_b, n_elems = fusion_payload_nbytes(
+            compressor, structs, fusion)
         vote = bool(getattr(compressor, "vote_aggregate", False))
         recv = communicator.recv_wire_bytes(comp_b, n_elems, world,
                                             vote=vote)

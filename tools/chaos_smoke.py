@@ -80,6 +80,11 @@ def main(argv=None) -> int:
                          "hits at 1/3 and 2/3 of --steps)")
     ap.add_argument("--audit-every", type=int, default=20,
                     help="consensus audit interval (with --sdc)")
+    ap.add_argument("--lint", action="store_true",
+                    help="first run graft-lint (repo rules + a static "
+                         "audit of this smoke's own grace config); "
+                         "findings land in the telemetry artifact as "
+                         "lint_finding events and fail the smoke")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -140,14 +145,15 @@ def main(argv=None) -> int:
                      else (args.steps // 3, 2 * args.steps // 3))
         sdc = ChaosParams(rank=args.sdc_rank, at_steps=sdc_steps,
                           seed=args.seed + 2)
-    grc = grace_from_params({"compressor": "topk", "compress_ratio": 0.3,
-                             "memory": "residual",
-                             "communicator": "allgather",
-                             "escape": "fp16",
-                             "consensus": consensus,
-                             # ring sized to the flush window so a healthy
-                             # run never wraps between flushes
-                             "telemetry": max(2 * args.telemetry_every, 16)})
+    grace_params = {"compressor": "topk", "compress_ratio": 0.3,
+                    "memory": "residual",
+                    "communicator": "allgather",
+                    "escape": "fp16",
+                    "consensus": consensus,
+                    # ring sized to the flush window so a healthy
+                    # run never wraps between flushes
+                    "telemetry": max(2 * args.telemetry_every, 16)}
+    grc = grace_from_params(grace_params)
     grc = dataclasses.replace(grc, communicator=ChaosCommunicator(
         inner=grc.communicator, nan_prob=args.nan_prob, rank=args.rank,
         seed=args.seed + 1))
@@ -173,6 +179,36 @@ def main(argv=None) -> int:
         reader = TelemetryReader(sink, every=args.telemetry_every)
     monitor = GuardMonitor(sink=sink)
     consensus_mon = ConsensusMonitor(sink=sink)
+
+    if args.lint:
+        # Static gate before any step runs: repo rules + the four jaxpr
+        # passes over THIS smoke's production config (pre-chaos-wrapper —
+        # the injectors are test fixtures, not an audited deployment).
+        # Findings become lint_finding events in the same JSONL artifact
+        # as the guard/consensus trail; errors fail the smoke fast.
+        from grace_tpu.analysis import audit_config, run_repo_rules
+        from grace_tpu.analysis.report import emit_to_sink
+        lint_findings = run_repo_rules() + audit_config(
+            {"name": "chaos_smoke-config",
+             "params": grace_params,
+             "passes": ("collective_consistency", "bit_exactness",
+                        "signature_stability")})
+        if sink is not None and lint_findings:
+            emit_to_sink(lint_findings, sink)
+        errors = [f for f in lint_findings if f.severity == "error"]
+        print(f"[chaos_smoke] graft-lint: {len(errors)} error(s), "
+              f"{len(lint_findings) - len(errors)} warning(s)")
+        if errors:
+            for f in errors:
+                print(f"[chaos_smoke]   {f.pass_name} {f.config}: "
+                      f"{f.message}", file=sys.stderr)
+            print("[chaos_smoke] FAIL: graft-lint found static SPMD "
+                  "hazards — not running the chaos matrix on a config "
+                  "that can deadlock a pod", file=sys.stderr)
+            if sink is not None:
+                sink.close()
+            return 1
+
     t0 = time.perf_counter()
     loss = float("nan")
     for i in range(args.steps):

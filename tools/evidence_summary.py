@@ -237,6 +237,15 @@ def build() -> str:
                 f"CPU-mesh smoke sweep: {len(data_rows)} configs measured "
                 "in `BENCH_ALL_CPU.json` (throughput ratios are host-bound "
                 f"artifacts; the wire columns are the content{skip_s}).")
+    lint = _load("LINT_LAST.json")
+    if isinstance(lint, dict) and "errors" in lint:
+        when = (lint.get("captured_at") or "").split("T")[0]
+        parts.append(
+            f"Static analysis: `graft_lint --all-configs` → "
+            f"{lint['errors']} error(s) / {lint.get('warnings', 0)} "
+            f"warning(s) over {lint.get('configs_audited', '?')} configs + "
+            f"{lint.get('rules_checked', '?')} repo rules "
+            f"(`LINT_LAST.json`{', ' + when if when else ''}).")
     return "\n".join(parts).rstrip() + "\n"
 
 
