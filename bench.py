@@ -198,6 +198,11 @@ def setup_platform(platform: str):
 ICI_RING_BYTES_PER_S = 9.0e10
 DCN_BYTES_PER_S = 2.5e10
 PROJECTION_WORLDS = (8, 16, 64, 256)
+# Cross-slice scenario topology: slices of 8 chips (the one real v5e slice
+# this repo has measured), DCN between them. Drives the per-link
+# (ici_bytes, dcn_bytes) split in each projection row via the shared
+# Communicator.recv_link_bytes model.
+XSLICE_CHIPS = 8
 
 # Stamped ONCE per evidence document (_write_evidence) and once in the
 # headline JSON line so the numbers carry their own assumptions (VERDICT r4
@@ -220,7 +225,17 @@ PROJECTION_MODEL = {
         "allreduce overlaps the backward pass) benefits from overlap more "
         "than compressed (whose gather waits on compress), so "
         "speedup_vs_dense is an OPTIMISTIC bound for compression wherever "
-        "wire dominates and both get pessimistic step times."),
+        "wire dominates and both get pessimistic step times. Measure the "
+        "realized overlap fraction from a device trace with "
+        "tools/perf_report.py (grace_tpu.profiling) to close the gap."),
+    "per_link": (
+        f"each row's xslice block splits received bytes by link class via "
+        f"Communicator.recv_link_bytes under a Topology(slice_size="
+        f"{XSLICE_CHIPS}) and prices ici/dcn separately. Flat communicators "
+        "degenerate to all-DCN the moment the axis crosses slices (the "
+        "critical rank's incoming ring link is the slice boundary); a "
+        "hierarchical ICI×DCN schedule earns a mixed split by overriding "
+        "recv_link_bytes, and these projections pick it up unchanged."),
 }
 
 
@@ -240,13 +255,27 @@ def recv_bytes_model(comm, vote: bool, payload_b: int, n_elems: int,
 def project_multichip(step_s: float, dense_step_s: float, grace,
                       wire_b: int, dense_b: int, n_elems: int) -> list:
     """Projected per-step wire cost and speedup-vs-dense at pod scales.
-    Dense rides a ring allreduce (2·(W-1)/W·bytes received per rank)."""
+    Dense rides a ring allreduce — priced through the same shared
+    ``Communicator.recv_link_bytes`` model as the compressed config, so
+    the two sides of every ratio can never use different wire math.
+
+    Three scenarios per world: all-ICI (one giant slice), all-DCN (the
+    legacy flat pessimum), and ``xslice`` — slices of ``XSLICE_CHIPS``
+    chips with the per-link (ici_bytes, dcn_bytes) split priced at each
+    link's own bandwidth. For today's flat communicators xslice collapses
+    to the DCN leg beyond one slice (see recv_link_bytes); it exists so a
+    hierarchical communicator's mixed split is projected honestly."""
+    from grace_tpu.comm import Allreduce
+    from grace_tpu.core import Topology
+
     vote = getattr(grace.compressor, "vote_aggregate", False)
+    dense_comm = Allreduce()
+    xtopo = Topology(slice_size=XSLICE_CHIPS)
     out = []
     for w in PROJECTION_WORLDS:
         cfg_recv = recv_bytes_model(grace.communicator, vote, wire_b,
                                     n_elems, w)
-        dense_recv = 2 * dense_b * (w - 1) // w
+        dense_recv = dense_comm.recv_wire_bytes(dense_b, n_elems, w)
         row = {"world": w, "recv_bytes_per_rank": cfg_recv}
         for net, bw in (("ici", ICI_RING_BYTES_PER_S),
                         ("dcn", DCN_BYTES_PER_S)):
@@ -254,6 +283,24 @@ def project_multichip(step_s: float, dense_step_s: float, grace,
             t_dense = dense_step_s + dense_recv / bw
             row[f"step_ms_{net}"] = round(t_cfg * 1e3, 3)
             row[f"speedup_vs_dense_{net}"] = round(t_dense / t_cfg, 3)
+        cfg_link = grace.communicator.recv_link_bytes(
+            wire_b, n_elems, w, topology=xtopo, vote=vote)
+        dense_link = dense_comm.recv_link_bytes(
+            dense_b, n_elems, w, topology=xtopo)
+
+        def t_split(base_s, link):
+            return (base_s + link.ici / ICI_RING_BYTES_PER_S
+                    + link.dcn / DCN_BYTES_PER_S)
+
+        t_cfg = t_split(step_s, cfg_link)
+        row["xslice"] = {
+            "slice_size": XSLICE_CHIPS,
+            "ici_bytes": cfg_link.ici,
+            "dcn_bytes": cfg_link.dcn,
+            "step_ms": round(t_cfg * 1e3, 3),
+            "speedup_vs_dense": round(
+                t_split(dense_step_s, dense_link) / t_cfg, 3),
+        }
         out.append(row)
     return out
 

@@ -73,24 +73,20 @@ class Finding:
 
 
 def _stage_of(eqn) -> str:
-    """The canonical stage the equation was traced under: longest matching
-    ``grace/...`` scope from the shared vocabulary
-    (:data:`grace_tpu.telemetry.scopes.ALL_STAGES`), falling back to the
-    raw ``grace/`` segment for ad-hoc sub-scopes."""
-    from grace_tpu.telemetry.scopes import ALL_STAGES
+    """The canonical stage the equation was traced under — the shared
+    longest-prefix vocabulary match
+    (:func:`grace_tpu.telemetry.scopes.match_stage`), applied to the
+    equation's ``name_stack``. The profiler trace analyzer
+    (:mod:`grace_tpu.profiling`) attributes device spans with literally the
+    same function, so static findings and measured time name stages
+    identically."""
+    from grace_tpu.telemetry.scopes import match_stage
 
     try:
         stack = str(eqn.source_info.name_stack)
     except Exception:
         return ""
-    for stage in ALL_STAGES:
-        if stage in stack:
-            return stage
-    segs = [seg for seg in stack.split("/") if seg]
-    if "grace" not in segs:
-        return ""
-    i = segs.index("grace")
-    return "/".join(segs[i:i + 2])
+    return match_stage(stack)
 
 
 def _axes_of(eqn) -> Tuple[str, ...]:
@@ -438,6 +434,32 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
             details=(("model_bytes", int(model)),
                      ("counted_bytes", int(counted)),
                      ("world", traced.world)))]
+    # Scalar model reconciles — now hold the per-link breakdown to it.
+    # The split (ici, dcn) must sum to the scalar bit-exactly under any
+    # topology: a communicator that overrides recv_link_bytes without
+    # keeping the identity (or vice versa) would make bench projections
+    # price different bytes than telemetry records. Checked at both the
+    # single-slice default and a slice boundary that forces the DCN leg.
+    from grace_tpu.core import Topology
+    for topo in (None, Topology(slice_size=max(1, traced.world // 2))):
+        link = grace.communicator.recv_link_bytes(
+            comp_b, n_elems, traced.world, topology=topo, vote=vote)
+        if link.ici + link.dcn != model:
+            return [Finding(
+                pass_name="wire_reconciliation", config=traced.name,
+                severity="error", stage="grace/exchange",
+                message=(
+                    f"{type(grace.communicator).__name__}.recv_link_bytes "
+                    f"splits into ici={link.ici} + dcn={link.dcn} = "
+                    f"{link.ici + link.dcn} B under topology "
+                    f"{topo!r}, but recv_wire_bytes models {model} B — the "
+                    "per-link breakdown and the scalar model must be one "
+                    "implementation (override _recv_total_bytes, not the "
+                    "public methods)"),
+                details=(("model_bytes", int(model)),
+                         ("ici_bytes", int(link.ici)),
+                         ("dcn_bytes", int(link.dcn)),
+                         ("world", traced.world)))]
     return []
 
 

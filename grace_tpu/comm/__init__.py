@@ -144,8 +144,8 @@ class Allreduce(Communicator):
 
     vote_dtype: str = "bfloat16"
 
-    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
-                        vote: bool = False) -> int:
+    def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
+                          world: int, vote: bool = False) -> int:
         if vote:
             # psum of dense ±1 votes in bf16 (2 bytes), ring: 2·(W-1)/W·n·2
             return 2 * 2 * n_elems * (world - 1) // max(1, world)
@@ -251,8 +251,8 @@ class SignAllreduce(Communicator):
 
     vote_dtype: str = "bfloat16"
 
-    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
-                        vote: bool = False) -> int:
+    def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
+                          world: int, vote: bool = False) -> int:
         return 2 * 2 * n_elems * (world - 1) // max(1, world)
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
@@ -438,8 +438,8 @@ class TwoShotAllreduce(Communicator):
     stage2_feedback: bool = False
     shard_parallel = True
 
-    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
-                        vote: bool = False) -> int:
+    def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
+                          world: int, vote: bool = False) -> int:
         # stage-1 all_to_all + stage-2 all_gather, each ~payload_b·(W-1)/W
         return 2 * payload_nbytes * (world - 1) // max(1, world)
 
@@ -581,8 +581,8 @@ class RingAllreduce(Communicator):
 
     shard_parallel = True
 
-    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
-                        vote: bool = False) -> int:
+    def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
+                          world: int, vote: bool = False) -> int:
         # (W-1) reduce-scatter hop payloads + (W-1) gathered shard
         # payloads, each ~payload/W: ≈ 2·payload·(W-1)/W, flat in W.
         return 2 * payload_nbytes * (world - 1) // max(1, world)
@@ -729,8 +729,8 @@ class Identity(Communicator):
     injectable no-comm fake the reference never wrote (SURVEY.md §4).
     """
 
-    def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
-                        vote: bool = False) -> int:
+    def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
+                          world: int, vote: bool = False) -> int:
         return 0
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor

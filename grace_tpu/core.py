@@ -31,7 +31,7 @@ decompression is then value-exact without a length field.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +59,73 @@ DEFAULT_AXIS = "data"
 # drifted model "pass" defeats the audit — fix the model instead.
 WIRE_MODEL_RTOL = 0.10
 WIRE_MODEL_ATOL = 256
+
+
+class LinkBytes(NamedTuple):
+    """Per-rank received bytes split by the link class they arrive over.
+
+    ``ici`` is intra-slice interconnect traffic (the fast on-chip torus),
+    ``dcn`` cross-slice data-center network traffic (~3.6× slower per the
+    public per-chip numbers — see ``bench.PROJECTION_MODEL``). The two are
+    priced separately by the bench projections; their sum is the scalar
+    :meth:`Communicator.recv_wire_bytes` the telemetry ring records and the
+    static auditor reconciles — the split refines the scalar, it never
+    disagrees with it (``ici + dcn == recv_wire_bytes`` is enforced by the
+    auditor's wire-reconciliation pass and pinned bit-exactly in
+    tests/test_communicators.py for every communicator).
+    """
+
+    ici: int
+    dcn: int
+
+    @property
+    def total(self) -> int:
+        return self.ici + self.dcn
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Mesh link topology: which ranks share an ICI domain.
+
+    Ranks ``[k·slice_size, (k+1)·slice_size)`` form one ICI-connected slice;
+    traffic between slices rides DCN. ``slice_size=None`` (the default)
+    means a single slice spans any world — every byte is ICI, which is the
+    regime all committed single-slice measurements ran in.
+
+    This is deliberately the *minimal* descriptor the wire model needs:
+    per-rank received bytes only depend on whether the collective's schedule
+    stays inside one slice or crosses the boundary (see
+    :meth:`Communicator.recv_link_bytes` for the critical-path argument).
+    Richer descriptors (torus dims, per-link counts) belong in the bandwidth
+    constants of the projection, not here.
+    """
+
+    slice_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.slice_size is not None and self.slice_size < 1:
+            raise ValueError(f"slice_size must be >= 1 or None; "
+                             f"got {self.slice_size}")
+
+    def crosses_dcn(self, world: int) -> bool:
+        """True iff a flat collective over ``world`` ranks spans slices."""
+        return self.slice_size is not None and world > self.slice_size
+
+    @classmethod
+    def detect(cls, devices=None) -> "Topology":
+        """Best-effort topology of the live devices: group by the TPU
+        runtime's ``slice_index`` when exposed (multislice), else a single
+        slice. CPU/simulated meshes are always one slice."""
+        import jax
+
+        devices = list(devices) if devices is not None else jax.devices()
+        slices = {getattr(d, "slice_index", 0) or 0 for d in devices}
+        if len(slices) <= 1:
+            return cls()
+        return cls(slice_size=max(1, len(devices) // len(slices)))
+
+
+SINGLE_SLICE = Topology()
 
 
 def axis_size(axis_name) -> int:
@@ -208,6 +275,47 @@ class Communicator:
         pad = (-n) % w
         return w, (n + pad) // w, pad
 
+    def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
+                          world: int, vote: bool = False) -> int:
+        """Schedule-total received bytes per rank — the per-communicator
+        formula. Subclasses override THIS (not ``recv_wire_bytes`` /
+        ``recv_link_bytes``), so the scalar model and the per-link split
+        share one implementation and can never drift apart. Default:
+        gather-style, every other rank's payload arrives
+        (``Allgather``/``Broadcast``); reduce-style subclasses override.
+        """
+        return payload_nbytes * max(0, world - 1)
+
+    def recv_link_bytes(self, payload_nbytes: int, n_elems: int, world: int,
+                        topology: Optional[Topology] = None,
+                        vote: bool = False) -> LinkBytes:
+        """Per-rank received bytes split by link class — ``(ici, dcn)``.
+
+        The split is the **critical-path rank's** view of the flat schedule
+        the collectives ride: in a ring/gather laid over the mesh axis, each
+        rank receives every byte over its single incoming neighbor link, and
+        the collective finishes when the slowest rank does. When
+        ``topology`` says the axis spans more than one ICI slice, some
+        rank's incoming link is a DCN boundary link — every pipelined chunk
+        crosses it, so that rank (and therefore the collective) is priced
+        entirely at DCN. Hence a *flat* communicator's breakdown is all-ICI
+        within one slice and all-DCN the moment the axis crosses slices:
+        the honest statement of why flat schedules collapse at multislice
+        scale (topk+allgather losing to dense at W=256 on DCN). A
+        hierarchical ICI×DCN communicator earns a genuinely mixed split by
+        overriding this method — bench projections, telemetry, and the
+        auditor all pick it up for free.
+
+        ``topology=None`` means :data:`SINGLE_SLICE` (all ICI), matching
+        every committed single-slice measurement.
+        """
+        total = int(self._recv_total_bytes(payload_nbytes, n_elems, world,
+                                           vote=vote))
+        topo = topology if topology is not None else SINGLE_SLICE
+        if topo.crosses_dcn(world):
+            return LinkBytes(ici=0, dcn=total)
+        return LinkBytes(ici=total, dcn=0)
+
     def recv_wire_bytes(self, payload_nbytes: int, n_elems: int, world: int,
                         vote: bool = False) -> int:
         """Logical bytes RECEIVED per rank per step at world size ``world``.
@@ -220,8 +328,9 @@ class Communicator:
         projections (``bench.recv_bytes_model``) and the in-graph telemetry
         ring's ``wire_bytes`` field — payload bytes alone are communicator-
         blind and cannot rank e.g. ring/two-shot's O(k) against allgather's
-        O(W·k). Default: gather-style, every other rank's payload arrives
-        (``Allgather``/``Broadcast``); reduce-style subclasses override.
+        O(W·k). Defined as the sum of the per-link split
+        (:meth:`recv_link_bytes`), so the scalar and the breakdown are
+        structurally one model.
 
         This model is *audited*: the static analyzer
         (:mod:`grace_tpu.analysis`, ``tools/graft_lint.py``) counts the
@@ -230,7 +339,8 @@ class Communicator:
         ``WIRE_MODEL_ATOL`` — an override that stops matching its
         ``exchange``/``step`` is a lint error, not a silent telemetry lie.
         """
-        return payload_nbytes * max(0, world - 1)
+        return self.recv_link_bytes(payload_nbytes, n_elems, world,
+                                    vote=vote).total
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:

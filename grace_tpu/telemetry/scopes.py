@@ -24,7 +24,8 @@ from typing import Iterator
 
 import jax
 
-__all__ = ["trace_stage", "ALL_STAGES", "STAGE_COMPENSATE", "STAGE_COMPRESS",
+__all__ = ["trace_stage", "match_stage", "ALL_STAGES",
+           "STAGE_COMPENSATE", "STAGE_COMPRESS",
            "STAGE_EXCHANGE", "STAGE_DECOMPRESS", "STAGE_MEMORY_UPDATE",
            "STAGE_FWD_BWD", "STAGE_OPTIMIZER", "STAGE_APPLY",
            "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS",
@@ -59,6 +60,37 @@ ALL_STAGES = tuple(sorted(
      STAGE_MEMORY_UPDATE, STAGE_FWD_BWD, STAGE_OPTIMIZER, STAGE_APPLY,
      STAGE_TELEMETRY, STAGE_DENSE_ESCAPE, STAGE_CONSENSUS, STAGE_RING_HOP),
     key=len, reverse=True))
+
+
+def match_stage(path: str) -> str:
+    """The canonical stage a scope path / op name belongs to.
+
+    Scope paths nest (``grace/optimizer/grace/exchange/grace/decompress``
+    is a real jax name stack: the optimizer scope wraps the transform,
+    which wraps the exchange, which wraps the decode), so the *rightmost*
+    matching stage from :data:`ALL_STAGES` wins — the innermost scope is
+    the one doing the work. Ties at the same position take the longest
+    stage (``grace/exchange/psum_vote`` attributes to ``grace/exchange``,
+    never a shorter accidental prefix). Falls back to the raw two-segment
+    ``grace/<x>`` prefix for ad-hoc sub-scopes, and ``""`` for paths
+    outside the grace vocabulary. ONE implementation shared by the static
+    auditor's finding attribution (:mod:`grace_tpu.analysis`) and the
+    profiler trace analyzer (:mod:`grace_tpu.profiling`) — both read the
+    scope names :func:`trace_stage` wrote, so they must parse them
+    identically.
+    """
+    best, best_pos = "", -1
+    for stage in ALL_STAGES:            # longest-first: ties keep the longer
+        pos = path.rfind(stage)
+        if pos > best_pos:
+            best, best_pos = stage, pos
+    if best:
+        return best
+    segs = [seg for seg in path.split("/") if seg]
+    if "grace" not in segs:
+        return ""
+    i = segs.index("grace")
+    return "/".join(segs[i:i + 2])
 
 
 @contextlib.contextmanager

@@ -6,12 +6,18 @@ grace_dl/torch/compressor/qsgd.py:14-15 and examples). On TPU the profiler
 of record is ``jax.profiler`` (Perfetto/TensorBoard traces of the XLA
 schedule, including ICI collective overlap); ``StepTimer`` gives cheap
 steady-state throughput numbers with correct async-dispatch handling.
+
+The runtime recorder built on top of this (step-time percentiles, retrace
+detection, memory watermarks, sink emission) lives in
+:class:`grace_tpu.profiling.ProfileRecorder`; the offline trace analyzer is
+:mod:`grace_tpu.profiling.trace_analysis`.
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from typing import Iterator, List, Optional
 
 import jax
@@ -42,15 +48,43 @@ class StepTimer:
                 timer.sync_on(loss)     # block on a step OUTPUT, not the world
 
     ``mean_sec``/``p50_sec`` skip the warmup steps (compile + autotune).
+
+    Without ``sync_on`` the timer measures only the *async dispatch* of the
+    step — microseconds of Python enqueueing work, not device execution —
+    and the resulting "throughput" is fiction. The first such step warns
+    once, and :attr:`measured_async_dispatch` stays True so downstream
+    consumers (``grace_tpu.profiling.ProfileRecorder`` stamps it on every
+    emitted record) can flag the numbers.
+
+    A step body that raises still records its timing row (wall-clock up to
+    the raise) and bumps :attr:`failed_steps` — a crash mid-run used to
+    silently swallow the row, hiding exactly the slow step that died.
     """
 
     def __init__(self, warmup: int = 2):
         self.warmup = warmup
+        self.failed_steps = 0
+        # True once any completed step was timed without a sync target:
+        # the recorded times are dispatch-only and throughput is unusable.
+        self.measured_async_dispatch = False
         self._times: List[float] = []
         self._sync_target = None
+        self._warned_async = False
 
     def sync_on(self, out) -> None:
         self._sync_target = out
+
+    def _note_async_dispatch(self) -> None:
+        self.measured_async_dispatch = True
+        if not self._warned_async:
+            self._warned_async = True
+            warnings.warn(
+                "StepTimer.step() completed without sync_on(): the recorded "
+                "time covers only async dispatch, not device execution — "
+                "call timer.sync_on(<a step output>) inside the step block "
+                "(jax dispatches asynchronously; without a blocking fetch "
+                "the step 'finishes' in microseconds).",
+                RuntimeWarning, stacklevel=3)
 
     @contextlib.contextmanager
     def step(self) -> Iterator[None]:
@@ -58,12 +92,22 @@ class StepTimer:
         try:
             yield
         except BaseException:
-            self._sync_target = None  # don't let a failed step poison the next
+            # Record the partial row (the slow step that died is the one a
+            # postmortem needs to see) but never let a failed step's sync
+            # target poison the next one.
+            self._sync_target = None
+            self._times.append(time.perf_counter() - t0)
+            self.failed_steps += 1
             raise
         if self._sync_target is not None:
             jax.block_until_ready(self._sync_target)
             self._sync_target = None
+        else:
+            self._note_async_dispatch()
         self._times.append(time.perf_counter() - t0)
+
+    def __len__(self) -> int:
+        return len(self._times)
 
     @property
     def steady(self) -> np.ndarray:
@@ -78,6 +122,10 @@ class StepTimer:
     @property
     def p50_sec(self) -> float:
         return float(np.median(self.steady))
+
+    def percentile_sec(self, q: float) -> float:
+        """Steady-state percentile, e.g. ``percentile_sec(99)``."""
+        return float(np.percentile(self.steady, q))
 
     def throughput(self, items_per_step: int) -> float:
         return items_per_step / self.mean_sec

@@ -272,6 +272,109 @@ def test_ring_wire_model_monotone_in_world():
 
 
 # ---------------------------------------------------------------------------
+# per-link (ici, dcn) wire model — ISSUE 6 prerequisite surgery
+# ---------------------------------------------------------------------------
+
+# The PRE-refactor scalar formulas, hardcoded: recv_wire_bytes is now the
+# sum of the per-link split, and this pins that the refactor moved ZERO
+# bytes — bit-identical for every communicator, world, and vote flag.
+_OLD_SCALAR = {
+    comm.Allreduce: lambda p, n, w, vote: (
+        2 * 2 * n * (w - 1) // max(1, w) if vote
+        else 2 * p * (w - 1) // max(1, w)),
+    comm.Allgather: lambda p, n, w, vote: p * max(0, w - 1),
+    comm.Broadcast: lambda p, n, w, vote: p * max(0, w - 1),
+    comm.SignAllreduce: lambda p, n, w, vote:
+        2 * 2 * n * (w - 1) // max(1, w),
+    comm.TwoShotAllreduce: lambda p, n, w, vote:
+        2 * p * (w - 1) // max(1, w),
+    comm.RingAllreduce: lambda p, n, w, vote:
+        2 * p * (w - 1) // max(1, w),
+    comm.Identity: lambda p, n, w, vote: 0,
+}
+
+
+@pytest.mark.parametrize("cls", _COMMUNICATORS,
+                         ids=[c.__name__ for c in _COMMUNICATORS])
+def test_recv_link_bytes_sums_to_old_scalar_model(cls):
+    from grace_tpu.core import Topology
+
+    c = cls()
+    payload, n = 8192, 2048
+    topologies = (None, Topology(), Topology(slice_size=4),
+                  Topology(slice_size=8), Topology(slice_size=1024))
+    for w in (1, 2, 4, 8, 64, 256):
+        for vote in (False, True):
+            old = _OLD_SCALAR[cls](payload, n, w, vote)
+            assert c.recv_wire_bytes(payload, n, w, vote=vote) == old
+            for topo in topologies:
+                lb = c.recv_link_bytes(payload, n, w, topology=topo,
+                                       vote=vote)
+                assert lb.ici + lb.dcn == old == lb.total, \
+                    (cls.__name__, w, vote, topo, lb)
+
+
+@pytest.mark.parametrize("cls", _COMMUNICATORS,
+                         ids=[c.__name__ for c in _COMMUNICATORS])
+def test_recv_link_bytes_split_semantics(cls):
+    """Flat schedules: all-ICI within one slice, all-DCN once the axis
+    crosses a slice boundary (the critical rank's incoming link)."""
+    from grace_tpu.core import Topology
+
+    c = cls()
+    payload, n, w = 8192, 2048, 64
+    inside = c.recv_link_bytes(payload, n, w,
+                               topology=Topology(slice_size=64))
+    assert inside.dcn == 0
+    crossing = c.recv_link_bytes(payload, n, w,
+                                 topology=Topology(slice_size=8))
+    assert crossing.ici == 0
+    assert crossing.dcn == inside.ici         # same bytes, other link
+    # default topology is single-slice: everything ICI
+    assert c.recv_link_bytes(payload, n, w).dcn == 0
+
+
+def test_topology_descriptor():
+    from grace_tpu.core import SINGLE_SLICE, Topology
+
+    assert not SINGLE_SLICE.crosses_dcn(10 ** 6)
+    assert Topology(slice_size=8).crosses_dcn(9)
+    assert not Topology(slice_size=8).crosses_dcn(8)
+    with pytest.raises(ValueError):
+        Topology(slice_size=0)
+    # CPU / simulated devices: always one slice
+    assert Topology.detect().slice_size is None
+
+
+def test_bench_projection_uses_shared_per_link_model():
+    """The xslice projection block prices the split the communicator
+    reports — dense and compressed both through recv_link_bytes."""
+    import bench
+
+    class FakeComp:
+        vote_aggregate = False
+
+    class FakeGrace:
+        compressor = FakeComp()
+        communicator = comm.Allgather()
+
+    rows = bench.project_multichip(0.1, 0.1, FakeGrace(),
+                                   wire_b=10 ** 6, dense_b=10 ** 8,
+                                   n_elems=25 * 10 ** 6)
+    for row in rows:
+        x = row["xslice"]
+        assert x["slice_size"] == bench.XSLICE_CHIPS
+        assert x["ici_bytes"] + x["dcn_bytes"] == row["recv_bytes_per_rank"]
+        if row["world"] > bench.XSLICE_CHIPS:
+            assert x["ici_bytes"] == 0        # flat gather beyond one slice
+            # flat DCN pricing matches the legacy all-DCN scenario
+            assert x["step_ms"] == row["step_ms_dcn"]
+        else:
+            assert x["dcn_bytes"] == 0
+            assert x["step_ms"] == row["step_ms_ici"]
+
+
+# ---------------------------------------------------------------------------
 # repo rule engine
 # ---------------------------------------------------------------------------
 

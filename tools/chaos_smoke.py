@@ -80,6 +80,12 @@ def main(argv=None) -> int:
                          "hits at 1/3 and 2/3 of --steps)")
     ap.add_argument("--audit-every", type=int, default=20,
                     help="consensus audit interval (with --sdc)")
+    ap.add_argument("--profile", action="store_true",
+                    help="record runtime profiling (step-time percentiles, "
+                         "compile/retrace events, memory watermarks, "
+                         "GraceState footprint check) into the telemetry "
+                         "artifact as perf_* events "
+                         "(grace_tpu.profiling.ProfileRecorder)")
     ap.add_argument("--lint", action="store_true",
                     help="first run graft-lint (repo rules + a static "
                          "audit of this smoke's own grace config); "
@@ -179,6 +185,15 @@ def main(argv=None) -> int:
         reader = TelemetryReader(sink, every=args.telemetry_every)
     monitor = GuardMonitor(sink=sink)
     consensus_mon = ConsensusMonitor(sink=sink)
+    profiler = None
+    if args.profile:
+        from grace_tpu.profiling import ProfileRecorder
+        # Shares the telemetry sink so perf_* records land in the same
+        # JSONL stream as the metric rows and guard/consensus events (one
+        # artifact covers one run); close() is NOT delegated — the smoke
+        # owns the sink's lifetime.
+        profiler = ProfileRecorder(sink=sink, every=args.telemetry_every,
+                                   step_fn=step)
 
     if args.lint:
         # Static gate before any step runs: repo rules + the four jaxpr
@@ -217,7 +232,13 @@ def main(argv=None) -> int:
         lo = (i * batch) % len(images)
         b = (jnp.asarray(images[lo:lo + batch]),
              jnp.asarray(labels[lo:lo + batch]))
-        state, loss = step(state, b)
+        if profiler is not None:
+            with profiler.step():
+                state, loss = step(state, b)
+                profiler.sync_on(loss)
+            profiler.update(i)
+        else:
+            state, loss = step(state, b)
         monitor.update(i, guard_report(state))
         if sdc is not None:
             consensus_mon.update(i, audit_report(state))
@@ -225,6 +246,16 @@ def main(argv=None) -> int:
             reader.update(i, state)
     loss = float(loss)
     dt = time.perf_counter() - t0
+    if profiler is not None:
+        if args.steps % args.telemetry_every:
+            profiler.flush(args.steps - 1)        # drain the tail window
+        profiler.record_state_footprint(state, grc, params,
+                                        world=world, step=args.steps - 1)
+        arr = profiler.timer.steady * 1e3
+        print(f"[chaos_smoke] profiling: step p50 "
+              f"{np.percentile(arr, 50):.1f} ms, p99 "
+              f"{np.percentile(arr, 99):.1f} ms over {arr.size} steps | "
+              f"retraces {profiler.retraces}")
     if reader is not None:
         reader.flush(state)      # drain the tail window
         reader.close()

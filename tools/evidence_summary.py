@@ -246,6 +246,27 @@ def build() -> str:
             f"warning(s) over {lint.get('configs_audited', '?')} configs + "
             f"{lint.get('rules_checked', '?')} repo rules "
             f"(`LINT_LAST.json`{', ' + when if when else ''}).")
+    prof = _load("PROF_LAST.json")
+    if isinstance(prof, dict) and prof.get("stages_ms"):
+        when = (prof.get("captured_at") or "").split("T")[0]
+        top = max(prof["stages_ms"].items(), key=lambda kv: kv[1])
+        ov = prof.get("overlap_fraction")
+        steps = prof.get("step_times") or {}
+        bits = [f"total device time {_fmt(prof.get('total_device_ms'), 3)} "
+                f"ms, top stage {top[0]} ({_fmt(top[1], 3)} ms)"]
+        if ov is not None:
+            bits.append(f"overlap fraction {100.0 * ov:.1f}%")
+        if steps.get("p50_ms") is not None:
+            bits.append(f"step p50 {_fmt(steps['p50_ms'], 3)} ms")
+        regr = prof.get("regressions")
+        if regr is not None:
+            bits.append(f"{len(regr)} baseline regression(s)")
+        note = f" — {prof['note']}" if prof.get("note") else ""
+        parts.append("")
+        parts.append(
+            f"Performance attribution: `perf_report --trace "
+            f"{prof.get('trace', '?')}` → " + ", ".join(bits) +
+            f" (`PROF_LAST.json`{', ' + when if when else ''}){note}.")
     return "\n".join(parts).rstrip() + "\n"
 
 

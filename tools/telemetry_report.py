@@ -8,8 +8,11 @@ header line followed by per-step metric records
 Output (text, stdout): the provenance block, a per-metric stats table
 (count / mean / min / max / last over the per-step records), wire-traffic
 accounting including dense-fallback windows reconstructed from the
-``fallback`` flag flips, and the guard event log. Pure stdlib — usable on
-any box that holds the artifact, no jax required.
+``fallback`` flag flips, a profiling section (step-time percentiles,
+compile/retrace events, memory watermarks, and the GraceState footprint
+check, from ``grace_tpu.profiling.ProfileRecorder``'s ``perf_*`` records),
+and the guard event log — one report covers one run. Pure stdlib — usable
+on any box that holds the artifact, no jax required.
 
 Usage::
 
@@ -155,17 +158,81 @@ def render(provenance, records, events,
     else:
         out.append("  (none)")
 
+    perf = [e for e in events if str(e.get("event", "")).startswith("perf_")]
+    other = [e for e in events if e not in perf]
+    if perf:
+        out.append("")
+        out.append("== profiling (ProfileRecorder perf_* records) ==")
+        out.extend(_render_perf(perf))
+
     out.append("")
-    out.append(f"== guard events ({len(events)}) ==")
-    for e in events:
+    out.append(f"== guard events ({len(other)}) ==")
+    for e in other:
         extras = {k: v for k, v in e.items() if k not in ("event", "step")}
         brief = ", ".join(f"{k}={v}" for k, v in sorted(extras.items())
                           if isinstance(v, (int, float, bool)))
         out.append(f"  step {e.get('step', '?'):>6}: {e['event']}"
                    + (f"  ({brief})" if brief else ""))
-    if not events:
+    if not other:
         out.append("  (none)")
     return "\n".join(out)
+
+
+def _render_perf(perf: List[dict]) -> List[str]:
+    """Step-time percentiles (last window wins — they are cumulative),
+    compile/retrace events, memory watermarks, footprint check."""
+    out = []
+    times = [e for e in perf if e["event"] == "perf_step_times"]
+    if times:
+        t = times[-1]
+        order = ["mean_ms", "p50_ms", "p90_ms", "p99_ms", "max_ms"]
+        keys = [k for k in order if k in t] + \
+            [k for k in sorted(t) if k.endswith("_ms") and k not in order]
+        pcts = ", ".join(f"{k[:-3]} {t[k]:.3f}" for k in keys)
+        out.append(f"  step times (n={t.get('n_steps', '?')}): {pcts} ms")
+        if t.get("sync_missing"):
+            out.append("  WARNING: timed without sync_on() — these are "
+                       "async-dispatch times, not step times")
+        if t.get("failed_steps"):
+            out.append(f"  failed steps recorded: {t['failed_steps']}")
+    compiles = [e for e in perf if e["event"] == "perf_compile"]
+    retraces = [e for e in perf if e["event"] == "perf_retrace"]
+    if compiles or retraces:
+        steps = ", ".join(str(e.get("step", "?")) for e in retraces)
+        out.append(f"  compiles observed: {len(compiles)}; retraces: "
+                   f"{len(retraces)}"
+                   + (f" at step(s) {steps} — the step function recompiled "
+                      "mid-run (weak-type/shape leak into carried state; "
+                      "see graft-lint signature_stability)"
+                      if retraces else ""))
+    mems = [e for e in perf if e["event"] == "perf_memory"]
+    if mems:
+        m = mems[-1]
+        peak = m.get("peak_bytes_in_use")
+        cur = m.get("bytes_in_use")
+        bits = []
+        if peak is not None:
+            bits.append(f"peak {peak:,d} B")
+        if cur is not None:
+            bits.append(f"in use {cur:,d} B")
+        out.append(f"  device memory watermark (max over "
+                   f"{m.get('n_devices', '?')} devices): "
+                   + ", ".join(bits))
+    feet = [e for e in perf if e["event"] == "perf_state_footprint"]
+    if feet:
+        f = feet[-1]
+        out.append(
+            f"  GraceState footprint: mem {f.get('mem_bytes', 0):,d} B, "
+            f"comp {f.get('comp_bytes', 0):,d} B, "
+            f"telem {f.get('telem_bytes', 0):,d} B")
+        if "footprint_matches" in f:
+            out.append("  footprint vs codec model: "
+                       + ("matches" if f["footprint_matches"] else
+                          "MISMATCH — live state was built under a "
+                          "different config than reported"))
+    if not out:
+        out.append("  (perf records present but empty)")
+    return out
 
 
 def main(argv=None) -> int:

@@ -5,11 +5,15 @@ lands below the 0.90 target, the next step is a device trace of the Top-K
 1% step on the fused 25.5M-element buffer (prime suspects: approx_max_k on
 the full buffer, the scatter in decompress — grace_tpu/ops/sparse.py).
 This script reuses bench.py's measurement core but wraps the timed window
-in a profiler trace so the per-op timeline is on disk for analysis with
-`python tools/tpu_profile.py --report` (summarizes the .xplane proto) even
-after the tunnel dies again.
+in a profiler trace so the per-op timeline is on disk for offline analysis
+even after the tunnel dies again. `--report` runs the shared trace analyzer
+(grace_tpu.profiling.trace_analysis — the same stage attribution, overlap
+fraction, and step percentiles tools/perf_report.py gates CI with) against
+the newest saved capture; it needs no devices, so the report works on any
+CPU box holding the profiles directory.
 
 Usage (on the chip):  python tools/tpu_profile.py [--config topk1pct]
+Offline anywhere:     python tools/tpu_profile.py --report [--outdir profiles]
 Output: profiles/<config>/plugins/profile/... (xplane + trace.json.gz)
 """
 
@@ -82,28 +86,23 @@ def profile_config(cfg_name: str, outdir: str) -> None:
     print(f"[profile] {cfg_name}: trace -> {outdir}", file=sys.stderr)
 
 
-def report(outdir: str, top: int = 25) -> None:
-    """Summarize the newest trace.json.gz under outdir: top ops by self time."""
-    import glob
-    import gzip
-    import json
-    from collections import defaultdict
+def report(outdir: str) -> None:
+    """Stage-attributed report of the newest capture under ``outdir`` via
+    the shared trace analyzer — per-stage device time (canonical
+    ``grace/...`` vocabulary), compute/collective split, overlap fraction,
+    step percentiles. Works offline on CPU against a saved trace; the
+    ad-hoc top-ops-by-name summary this replaces could not attribute time
+    to pipeline stages nor see overlap at all."""
+    from grace_tpu.profiling import analyze_trace, find_latest_trace
 
-    paths = sorted(glob.glob(os.path.join(
-        outdir, "**", "*.trace.json.gz"), recursive=True), key=os.path.getmtime)
-    if not paths:
-        print(f"no trace.json.gz under {outdir}", file=sys.stderr)
+    path = find_latest_trace(outdir)
+    if path is None:
+        print(f"no *.trace.json.gz / *.xplane.pb under {outdir}",
+              file=sys.stderr)
         return
-    with gzip.open(paths[-1], "rt") as f:
-        events = json.load(f).get("traceEvents", [])
-    by_name = defaultdict(float)
-    for e in events:
-        if e.get("ph") == "X" and e.get("dur"):
-            by_name[e["name"]] += e["dur"]
-    total = sum(by_name.values())
-    print(f"{paths[-1]}: {len(events)} events, {total/1e6:.3f}s total span")
-    for name, dur in sorted(by_name.items(), key=lambda kv: -kv[1])[:top]:
-        print(f"{dur/1e3:10.2f} ms  {100*dur/max(total,1):5.1f}%  {name[:90]}")
+    analysis = analyze_trace(path)
+    print(f"{path}:")
+    print(analysis.render())
 
 
 def main() -> None:
