@@ -233,9 +233,13 @@ PROJECTION_MODEL = {
         f"Communicator.recv_link_bytes under a Topology(slice_size="
         f"{XSLICE_CHIPS}) and prices ici/dcn separately. Flat communicators "
         "degenerate to all-DCN the moment the axis crosses slices (the "
-        "critical rank's incoming ring link is the slice boundary); a "
-        "hierarchical ICI×DCN schedule earns a mixed split by overriding "
-        "recv_link_bytes, and these projections pick it up unchanged."),
+        "critical rank's incoming ring link is the slice boundary); "
+        "HierarchicalAllreduce (communicator='hier') overrides "
+        "recv_link_bytes with the genuinely mixed split of its two-level "
+        "schedule — ~2·k·(S-1)/S on ICI, (K-1)·k/S on DCN — which is what "
+        "flips the W=256 xslice speedup above 1x dense for topk1pct; "
+        "graft-lint's wire_reconciliation pass audits the split "
+        "leg-by-leg against the traced collectives."),
 }
 
 

@@ -10,7 +10,8 @@ reference.
 """
 
 from grace_tpu.core import Communicator, Compressor, Memory
-from grace_tpu.comm import (Allgather, Allreduce, Broadcast, Identity,
+from grace_tpu.comm import (Allgather, Allreduce, Broadcast,
+                            HierarchicalAllreduce, Identity, RingAllreduce,
                             SignAllreduce, TwoShotAllreduce,
                             masked_broadcast)
 from grace_tpu.helper import Grace, grace_from_params
@@ -31,7 +32,7 @@ __version__ = "0.1.0"
 __all__ = [
     "Communicator", "Compressor", "Memory",
     "Allreduce", "Allgather", "Broadcast", "Identity", "SignAllreduce",
-    "TwoShotAllreduce",
+    "TwoShotAllreduce", "RingAllreduce", "HierarchicalAllreduce",
     "Grace", "grace_from_params", "grace_transform", "GraceState",
     "GuardState", "guard_transform", "guarded_chain",
     "ChaosCompressor", "ChaosCommunicator", "ChaosParams",

@@ -123,6 +123,27 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
     _cfg("randomk-ring", {"compressor": "randomk", "compress_ratio": 0.5,
                           "memory": "residual", "communicator": "ring",
                           "fusion": "flat"}),
+    # -- hierarchical ICI×DCN family (ISSUE 7): slice_size=4 puts a slice
+    #    boundary inside the 8-way audit mesh (K=2 slices), so the traced
+    #    schedule exercises both grouped sub-axis collectives AND the
+    #    per-link split reconciliation (wire_reconciliation counts the
+    #    intra-slice legs as ICI and the cross-slice gather as DCN against
+    #    HierarchicalAllreduce.recv_link_bytes — the mixed split that
+    #    makes the xslice projections trustworthy).
+    _cfg("topk1pct_hier", {"compressor": "topk", "compress_ratio": 0.01,
+                           "topk_algorithm": "chunk", "memory": "residual",
+                           "communicator": "hier", "slice_size": 4,
+                           "fusion": "flat"}),
+    _cfg("qsgd_hier", {"compressor": "qsgd", "quantum_num": 64,
+                       "use_pallas": False, "memory": "none",
+                       "communicator": "hier", "slice_size": 4,
+                       "fusion": "flat"}),
+    _cfg("none_hier", {"compressor": "none", "memory": "none",
+                       "communicator": "hier", "slice_size": 4,
+                       "fusion": "flat"}),
+    _cfg("signsgd_hier", {"compressor": "signsgd", "memory": "none",
+                          "communicator": "hier", "slice_size": 4,
+                          "fusion": "flat"}),
     # -- degenerate / fusion variants ---------------------------------------
     _cfg("none-identity", {"compressor": "none", "memory": "none",
                            "communicator": "identity"}),
@@ -150,6 +171,18 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
     _cfg("ring-guard-consensus",
          {"compressor": "qsgd", "quantum_num": 64, "use_pallas": False,
           "memory": "none", "communicator": "ring", "fusion": "flat",
+          "escape": "fp16", "consensus": True},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+    # The nested-axis schedule under the full resilience stack: the escape
+    # cond's branches now differ by grouped sub-axis collectives, and the
+    # consensus audit's fingerprint gathers run downstream of a
+    # hierarchically-aggregated update — collective_consistency must bless
+    # both (replicated predicates) with the two-level exchange in place.
+    _cfg("hier-guard-consensus",
+         {"compressor": "topk", "compress_ratio": 0.01,
+          "topk_algorithm": "chunk", "memory": "residual",
+          "communicator": "hier", "slice_size": 4, "fusion": "flat",
           "escape": "fp16", "consensus": True},
          passes=_NO_WIRE, mode="train",
          guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),

@@ -34,7 +34,8 @@ from grace_tpu.analysis.trace import TracedGraph
 __all__ = ["Finding", "PASS_NAMES", "run_passes",
            "pass_collective_consistency", "pass_bit_exactness",
            "pass_wire_reconciliation", "pass_signature_stability",
-           "collective_signature", "count_recv_bytes"]
+           "collective_signature", "count_recv_bytes",
+           "count_recv_link_bytes"]
 
 # Cross-replica primitives, by behavior class. `pbroadcast` is check_rep
 # bookkeeping (identity on every rank), not a wire collective.
@@ -355,39 +356,102 @@ def pass_bit_exactness(traced: TracedGraph) -> List[Finding]:
 # pass 3: wire-byte reconciliation against Communicator.recv_wire_bytes
 # ---------------------------------------------------------------------------
 
+def _group_size(eqn, world: int) -> int:
+    """Ranks one collective actually spans: the ``axis_index_groups`` group
+    size when set (the hierarchical communicator's nested sub-axes —
+    cross-slice peers, intra-slice peers), else the whole axis. Groups
+    partition the axis into equal-size sets, so the first group's length is
+    the per-rank schedule width."""
+    groups = eqn.params.get("axis_index_groups")
+    if not groups:
+        return world
+    return len(groups[0])
+
+
+def _crosses_slice(eqn, world: int, topology) -> bool:
+    """Whether this collective's schedule touches a DCN boundary link under
+    ``topology`` — the critical-path attribution of
+    :meth:`~grace_tpu.core.Communicator.recv_link_bytes`, derived from the
+    *traced* rank sets instead of the hand-maintained model:
+
+    * a ``ppermute`` crosses iff any (src, dst) pair sits in different
+      slices (a flat ring's wrap-around neighbor pair always does once the
+      axis spans slices — which is why flat rings price all-DCN);
+    * a grouped collective crosses iff any group mixes slices (the
+      hierarchical comm's cross-slice groups do; its intra-slice groups
+      never);
+    * an ungrouped full-axis collective crosses iff the axis itself does.
+    """
+    if topology is None or not topology.crosses_dcn(world):
+        return False
+    s = topology.slice_size
+    if eqn.primitive.name in _PERMUTES:
+        perm = eqn.params.get("perm") or ()
+        return any(int(a) // s != int(b) // s for a, b in perm)
+    groups = eqn.params.get("axis_index_groups")
+    if groups:
+        return any(len({int(r) // s for r in grp}) > 1 for grp in groups)
+    return True
+
+
 def count_recv_bytes(jaxpr, axis_name: str, world: int) -> int:
-    """Logical bytes RECEIVED per rank for the collectives in ``jaxpr``
-    (recursive; cond branches count as the max across branches — an upper
-    bound matching how the wire model prices the live path).
+    """Logical bytes RECEIVED per rank for the collectives in ``jaxpr`` —
+    the scalar view of :func:`count_recv_link_bytes`."""
+    link = count_recv_link_bytes(jaxpr, axis_name, world, None)
+    return link[0] + link[1]
+
+
+def count_recv_link_bytes(jaxpr, axis_name: str, world: int,
+                          topology) -> Tuple[int, int]:
+    """Per-rank received bytes of the collectives in ``jaxpr``, split into
+    ``(ici, dcn)`` by whether each collective's traced schedule crosses a
+    slice boundary under ``topology`` (recursive; cond branches count as
+    the branch with the larger total — an upper bound matching how the wire
+    model prices the live path). ``topology=None`` attributes everything to
+    ICI (the single-slice scalar count).
 
     Per-collective accounting mirrors the standard schedules the model in
-    :meth:`grace_tpu.core.Communicator.recv_wire_bytes` assumes: ring
-    all-reduce moves ``2·n·(W-1)/W``; a gather receives every other rank's
-    shard ``n·(W-1)``; a ppermute hop receives one full operand; all_to_all
-    and reduce_scatter receive ``n·(W-1)/W``.
+    :meth:`grace_tpu.core.Communicator.recv_wire_bytes` assumes, over the
+    ranks the collective actually spans (``axis_index_groups`` narrows a
+    collective to its group — the hierarchical communicator's nested
+    sub-axes): ring all-reduce moves ``2·n·(G-1)/G``; a gather receives
+    every other member's shard ``n·(G-1)``; a ppermute hop receives one
+    full operand; all_to_all and reduce_scatter receive ``n·(G-1)/G``.
     """
-    total = 0
+    ici = dcn = 0
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS and axis_name in _axes_of(eqn):
             nbytes = sum(_aval_nbytes(v.aval) for v in eqn.invars
                          if _is_var(v))
+            g = _group_size(eqn, world)
             if name in _REDUCTIONS:
-                total += 2 * nbytes * (world - 1) // max(1, world)
+                got = 2 * nbytes * (g - 1) // max(1, g)
             elif name in _GATHERS:
-                total += nbytes * max(0, world - 1)
+                got = nbytes * max(0, g - 1)
             elif name in _PERMUTES:
-                total += nbytes
+                got = nbytes
             else:                      # all_to_all / reduce_scatter
-                total += nbytes * (world - 1) // max(1, world)
+                got = nbytes * (g - 1) // max(1, g)
+            if _crosses_slice(eqn, world, topology):
+                dcn += got
+            else:
+                ici += got
         elif name == "cond":
-            total += max((count_recv_bytes(getattr(b, "jaxpr", b),
-                                           axis_name, world)
-                          for b in eqn.params["branches"]), default=0)
+            branches = [count_recv_link_bytes(getattr(b, "jaxpr", b),
+                                              axis_name, world, topology)
+                        for b in eqn.params["branches"]]
+            if branches:
+                bi, bd = max(branches, key=lambda x: x[0] + x[1])
+                ici += bi
+                dcn += bd
         else:
             for sub in _sub_jaxprs_of(eqn):
-                total += count_recv_bytes(sub, axis_name, world)
-    return total
+                si, sd = count_recv_link_bytes(sub, axis_name, world,
+                                               topology)
+                ici += si
+                dcn += sd
+    return ici, dcn
 
 
 def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
@@ -459,6 +523,45 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
                 details=(("model_bytes", int(model)),
                          ("ici_bytes", int(link.ici)),
                          ("dcn_bytes", int(link.dcn)),
+                         ("world", traced.world)))]
+    # Finally reconcile the split itself against the TRACED schedule: put a
+    # slice boundary on the audit mesh (the communicator's own slice_size
+    # when it declares one — the hierarchical comm's nested sub-axes must
+    # land on it — else world/2) and attribute each traced collective's
+    # bytes by whether its rank sets cross that boundary. This is what
+    # keeps a "mixed" recv_link_bytes honest: a hierarchical communicator
+    # whose intra-slice ring secretly crossed slices, or whose DCN leg
+    # moved more than the modeled partials, drifts leg-by-leg even when
+    # the scalar total still balances.
+    own_slice = getattr(grace.communicator, "slice_size", None)
+    audit_topo = Topology(slice_size=(int(own_slice) if own_slice
+                                      else max(1, traced.world // 2)))
+    counted_link = count_recv_link_bytes(
+        traced.body, traced.axis_name, traced.world, audit_topo)
+    model_link = grace.communicator.recv_link_bytes(
+        comp_b, n_elems, traced.world, topology=audit_topo, vote=vote)
+    for leg, got, want in (("ici", counted_link[0], model_link.ici),
+                           ("dcn", counted_link[1], model_link.dcn)):
+        tol = max(WIRE_MODEL_RTOL * max(got, want), WIRE_MODEL_ATOL)
+        if abs(got - want) > tol:
+            return [Finding(
+                pass_name="wire_reconciliation", config=traced.name,
+                severity="error", stage="grace/exchange",
+                message=(
+                    f"{type(grace.communicator).__name__}.recv_link_bytes "
+                    f"models {leg}={want} B under topology {audit_topo!r} "
+                    f"but the traced schedule moves {got} B over that link "
+                    f"class (counted split ici={counted_link[0]}, "
+                    f"dcn={counted_link[1]}) — drift {abs(got - want)} B "
+                    f"exceeds the documented tolerance "
+                    f"(rtol={WIRE_MODEL_RTOL}, atol={WIRE_MODEL_ATOL} B); "
+                    "the per-link projections and telemetry split are "
+                    "lying about which link the bytes ride"),
+                details=(("leg", leg),
+                         ("model_ici", int(model_link.ici)),
+                         ("model_dcn", int(model_link.dcn)),
+                         ("counted_ici", int(counted_link[0])),
+                         ("counted_dcn", int(counted_link[1])),
                          ("world", traced.world)))]
     return []
 

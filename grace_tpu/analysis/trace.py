@@ -88,17 +88,84 @@ class TracedGraph:
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
-def _find_shard_map_body(jaxpr):
-    """Depth-first search for the first shard_map equation's body jaxpr."""
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "shard_map":
-            body = eqn.params["jaxpr"]
-            return getattr(body, "jaxpr", body)
-        for sub in _sub_jaxprs(eqn):
-            found = _find_shard_map_body(sub)
-            if found is not None:
-                return found
-    return None
+def _is_jaxpr_var(v) -> bool:
+    return hasattr(v, "aval") and not hasattr(v, "val")
+
+
+# Sentinels for _seed_positions entries that carry no outer arg index.
+_CONST = "const"        # literal / hoisted constant: replicated on every rank
+_UNKNOWN = "unknown"    # computed between the inputs and the shard_map
+
+
+def _seed_positions(closed, n_outer: int):
+    """Map each shard_map *body* invar to the outer arg leaf it carries.
+
+    Returns ``(body, positions)`` for the first shard_map equation found
+    (depth-first through ``pjit``/``cond``/… wrappers — ``make_train_step``
+    jits, so the shard_map usually sits one ``pjit`` down). ``positions``
+    has one entry per body invar: the index of the flattened outer
+    argument leaf it forwards, :data:`_CONST` for a **hoisted constant** —
+    jnp constants created inside the traced step (codec chunk-index
+    tables, empty padding arrays, iota ramps) that shard_map lifts into
+    extra body invars *ahead of* the real arguments — or :data:`_UNKNOWN`
+    for a value computed on the way in. Constants are replicated by
+    construction (same bytes on every rank), so seeding them rank-varying
+    — which is what a naive positional zip does the moment one appears —
+    poisons the whole replication analysis: the escape/audit cond
+    predicates read as rank-varying and every legal branch divergence
+    becomes a false positive (first seen on the hierarchical
+    communicator's chunked Top-K stage-1 encode, whose empty chunk-index
+    constants shifted the mask).
+
+    ``positions`` is ``None`` when the shard_map/body arities disagree;
+    the whole result is ``None`` when no shard_map equation exists.
+    """
+
+    def classify(v, env, jaxpr):
+        if not _is_jaxpr_var(v):
+            return _CONST
+        if v in env:
+            return env[v]
+        if v in set(getattr(jaxpr, "constvars", ())):
+            return _CONST
+        return _UNKNOWN
+
+    def walk(jaxpr, env):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "shard_map":
+                body = eqn.params["jaxpr"]
+                body = getattr(body, "jaxpr", body)
+                if len(eqn.invars) != len(body.invars):
+                    return body, None
+                return body, [classify(v, env, jaxpr) for v in eqn.invars]
+            for sub in _sub_jaxprs(eqn):
+                ops = (eqn.invars[1:] if eqn.primitive.name == "cond"
+                       else eqn.invars)
+                if len(sub.invars) == len(ops):
+                    sub_env = {sv: classify(ov, env, jaxpr)
+                               for sv, ov in zip(sub.invars, ops)}
+                else:
+                    sub_env = {sv: _UNKNOWN for sv in sub.invars}
+                found = walk(sub, sub_env)
+                if found is not None:
+                    return found
+        return None
+
+    env0 = {v: (i if i < n_outer else _UNKNOWN)
+            for i, v in enumerate(closed.jaxpr.invars)}
+    return walk(closed.jaxpr, env0)
+
+
+def _seeds_from_positions(positions, mask: List[bool],
+                          n_invars: int) -> List[bool]:
+    """Rank-variance seed per body invar from a :func:`_seed_positions`
+    result: outer leaves take their mask entry, hoisted constants are
+    replicated, anything unresolvable is conservatively varying."""
+    if positions is None:
+        return [True] * n_invars
+    return [False if p is _CONST
+            else (mask[p] if isinstance(p, int) else True)
+            for p in positions]
 
 
 def _sub_jaxprs(eqn):
@@ -174,17 +241,18 @@ def trace_fn(fn, args: Sequence[Any], *, world: int = 8,
                    in_specs=tuple(P() for _ in range(n_args)),
                    out_specs=P(), check_vma=False)
     closed = jax.make_jaxpr(sm)(*args)
-    body = _find_shard_map_body(closed.jaxpr)
-    if body is None:
+    found = _seed_positions(closed, len(jax.tree_util.tree_leaves(
+        tuple(args))))
+    if found is None:
         raise ValueError("no shard_map equation found in the traced jaxpr")
+    body, positions = found
     flat = jax.tree_util.tree_leaves(tuple(args))
     mask = list(varying) if varying is not None else [True] * len(flat)
     if len(mask) != len(flat):
         raise ValueError(f"varying mask has {len(mask)} entries for "
                          f"{len(flat)} flattened arg leaves")
-    if len(body.invars) != len(flat):           # conservative fallback
-        mask = [True] * len(body.invars)
-    var_map = dict(zip(body.invars, mask))
+    seeds = _seeds_from_positions(positions, mask, len(body.invars))
+    var_map = dict(zip(body.invars, seeds))
     return TracedGraph(name=name, closed=closed, body=body, world=world,
                        axis_name=axis_name, varying=var_map,
                        meta=dict(meta or {}))
@@ -216,22 +284,29 @@ def trace_update(grace, *, world: int = 8, params=None,
     sm = shard_map(body, mesh=am, in_specs=(P(), P()),
                    out_specs=(P(), P()), check_vma=False)
     closed = jax.make_jaxpr(sm)(state_struct, grads_struct)
-    inner = _find_shard_map_body(closed.jaxpr)
-    if inner is None:
-        raise ValueError("no shard_map equation found in the traced update")
-
     state_flat = jax.tree_util.tree_leaves(state_struct)
     grads_flat = jax.tree_util.tree_leaves(grads_struct)
+    found = _seed_positions(closed, len(state_flat) + len(grads_flat))
+    if found is None:
+        raise ValueError("no shard_map equation found in the traced update")
+    inner, positions = found
+
     mask = (_varying_mask_from_specs(state_struct, axis_name)
             + [True] * len(grads_flat))
-    if len(inner.invars) != len(state_flat) + len(grads_flat):
-        mask = [True] * len(inner.invars)
-        state_in = []
-    else:
+    seeds = _seeds_from_positions(positions, mask, len(inner.invars))
+    state_in = []
+    if positions is not None:
+        # Body invar carrying outer arg leaf i (hoisted constants shift
+        # the real arguments, so positional zip is not enough).
+        arg_to_body = {i: p for p, i in enumerate(positions)
+                       if isinstance(i, int)}
         paths = _flat_paths(state_struct)
-        state_in = [(p, inner.invars[i].aval)
-                    for i, p in enumerate(paths)]
-    var_map = dict(zip(inner.invars, mask))
+        state_in = [(p, inner.invars[arg_to_body[i]].aval)
+                    for i, p in enumerate(paths)
+                    if i in arg_to_body]
+        if len(state_in) != len(paths):          # a state leaf went missing
+            state_in = []
+    var_map = dict(zip(inner.invars, seeds))
 
     # Body outputs are (updates..., new_state...): the state signature the
     # next step re-traces against is the trailing slice.
@@ -290,17 +365,17 @@ def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
     step = make_train_step(loss_fn, tx, mesh=am, axis_name=axis_name,
                            donate=False, consensus=consensus)
     closed = jax.make_jaxpr(step)(state_struct, batch)
-    inner = _find_shard_map_body(closed.jaxpr)
-    if inner is None:
-        raise ValueError("no shard_map equation found in the traced step")
-
     state_flat = jax.tree_util.tree_leaves(state_struct)
     batch_flat = jax.tree_util.tree_leaves(batch)
+    found = _seed_positions(closed, len(state_flat) + len(batch_flat))
+    if found is None:
+        raise ValueError("no shard_map equation found in the traced step")
+    inner, positions = found
+
     mask = (_varying_mask_from_specs(state_struct, axis_name)
             + [True] * len(batch_flat))
-    if len(inner.invars) != len(state_flat) + len(batch_flat):
-        mask = [True] * len(inner.invars)
-    var_map = dict(zip(inner.invars, mask))
+    seeds = _seeds_from_positions(positions, mask, len(inner.invars))
+    var_map = dict(zip(inner.invars, seeds))
     return TracedGraph(name=name, closed=closed, body=inner, world=world,
                        axis_name=axis_name, varying=var_map,
                        meta=dict(meta or {}))

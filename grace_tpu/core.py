@@ -113,16 +113,54 @@ class Topology:
 
     @classmethod
     def detect(cls, devices=None) -> "Topology":
-        """Best-effort topology of the live devices: group by the TPU
-        runtime's ``slice_index`` when exposed (multislice), else a single
-        slice. CPU/simulated meshes are always one slice."""
+        """Topology of the live devices: group by the TPU runtime's
+        ``slice_index`` when exposed (multislice), else a single slice.
+        CPU/simulated meshes are always one slice.
+
+        Hardened against the layouts a best-effort grouping used to
+        mis-size silently (``len(devices) // len(slices)`` truncates):
+
+        * a device list where only *some* devices expose ``slice_index``
+          is contradictory — half the fleet claims multislice, half
+          doesn't — and raises rather than guessing a slice width;
+        * uneven slices (e.g. 5+3 devices) have no single ``slice_size``;
+          the wire model's contiguous-block layout cannot describe them,
+          so they raise with the per-slice counts instead of flooring to
+          ``world // n_slices`` and mis-pricing every projection.
+
+        ``slice_index=None`` (some runtimes stub the attribute) counts as
+        absent. An empty device list is a single slice.
+        """
         import jax
 
         devices = list(devices) if devices is not None else jax.devices()
-        slices = {getattr(d, "slice_index", 0) or 0 for d in devices}
-        if len(slices) <= 1:
+        counts: dict = {}
+        missing = 0
+        for d in devices:
+            idx = getattr(d, "slice_index", None)
+            if idx is None:
+                missing += 1
+            else:
+                counts[idx] = counts.get(idx, 0) + 1
+        if counts and missing:
+            raise ValueError(
+                f"cannot detect topology: {missing} of {len(devices)} "
+                "devices expose no slice_index while "
+                f"{len(devices) - missing} do — a heterogeneous device "
+                "list (mixed runtimes / stale handles?) has no consistent "
+                "slice layout. Pass an explicit Topology(slice_size=...) "
+                "instead.")
+        if len(counts) <= 1:
             return cls()
-        return cls(slice_size=max(1, len(devices) // len(slices)))
+        sizes = sorted(set(counts.values()))
+        if len(sizes) > 1:
+            raise ValueError(
+                "cannot detect topology: slices are uneven — per-slice "
+                f"device counts {dict(sorted(counts.items()))} — so no "
+                "single slice_size describes the layout (the wire model "
+                "assumes contiguous equal slices). Pass an explicit "
+                "Topology(slice_size=...) for the layout you mean.")
+        return cls(slice_size=sizes[0])
 
 
 SINGLE_SLICE = Topology()
@@ -301,9 +339,11 @@ class Communicator:
         entirely at DCN. Hence a *flat* communicator's breakdown is all-ICI
         within one slice and all-DCN the moment the axis crosses slices:
         the honest statement of why flat schedules collapse at multislice
-        scale (topk+allgather losing to dense at W=256 on DCN). A
-        hierarchical ICI×DCN communicator earns a genuinely mixed split by
-        overriding this method — bench projections, telemetry, and the
+        scale (topk+allgather losing to dense at W=256 on DCN). The
+        hierarchical ICI×DCN communicator
+        (:class:`grace_tpu.comm.HierarchicalAllreduce`) earns a genuinely
+        mixed split by overriding this method — bench projections,
+        telemetry's ``wire_bytes_ici``/``wire_bytes_dcn`` fields, and the
         auditor all pick it up for free.
 
         ``topology=None`` means :data:`SINGLE_SLICE` (all ICI), matching

@@ -25,7 +25,8 @@ import optax
 from grace_tpu import comm
 from grace_tpu import compressors as C
 from grace_tpu import memories as M
-from grace_tpu.core import DEFAULT_AXIS, Communicator, Compressor, Memory
+from grace_tpu.core import (DEFAULT_AXIS, Communicator, Compressor, Memory,
+                            Topology)
 from grace_tpu.transform import grace_transform
 
 
@@ -47,13 +48,19 @@ class Grace:
                            # (grace_tpu.resilience.consensus). Arms the
                            # AuditState here; pass the same value to
                            # make_train_step(consensus=...) for the hook.
+    topology: Any = None   # None | core.Topology: the mesh link layout the
+                           # telemetry ring prices its per-link wire split
+                           # with (wire_bytes_ici/wire_bytes_dcn). None =
+                           # Topology.detect() at wire-plan time; set from
+                           # params["slice_size"] by grace_from_params.
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,
                                self.communicator, seed=seed,
                                fusion=self.fusion, escape=self.escape,
                                telemetry=self.telemetry,
-                               consensus=self.consensus)
+                               consensus=self.consensus,
+                               topology=self.topology)
 
 
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
@@ -143,6 +150,13 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
             stage2_feedback=bool(params.get("stage2_feedback", False)))
     if name in ("ring", "ring_allreduce"):
         return comm.RingAllreduce(axis_name=axis)
+    if name in ("hier", "hierarchical", "hier_allreduce"):
+        # slice_size: ranks [k*S, (k+1)*S) form one ICI slice; the
+        # two-level ICI×DCN schedule (intra-slice ring reduce-scatter,
+        # cross-slice partial exchange, intra-slice all-gather). None
+        # collapses to the flat ring (one slice).
+        return comm.HierarchicalAllreduce(
+            axis_name=axis, slice_size=params.get("slice_size"))
     if name in ("sign_allreduce", "signallreduce"):
         return comm.SignAllreduce(
             axis_name=axis,
@@ -174,11 +188,18 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
         else:
             raise ValueError(f"unknown escape compressor {escape!r} — use "
                              "'none'/'dense', 'fp16', or 'bf16'")
+    # slice_size also declares the mesh link layout: the telemetry ring's
+    # per-link wire split (wire_bytes_ici/wire_bytes_dcn) prices against
+    # the Topology it implies. Without it the layout is auto-detected
+    # (Topology.detect) — single slice on CPU/simulated meshes.
+    slice_size = params.get("slice_size")
     return Grace(compressor=_build_compressor(params, axis),
                  memory=_build_memory(params, axis),
                  communicator=_build_communicator(params, axis),
                  fusion=fusion,
                  escape=escape,
+                 topology=(Topology(slice_size=int(slice_size))
+                           if slice_size else None),
                  # True | ring capacity | {"capacity": ..,
                  # "compression_error": ..} — see grace_transform(telemetry=)
                  telemetry=params.get("telemetry"),

@@ -181,6 +181,26 @@ class ChaosCommunicator(Communicator):
         # rank gating agree with the collectives the inner one issues.
         object.__setattr__(self, "axis_name", self.inner.axis_name)
 
+    @property
+    def shard_parallel(self):  # type: ignore[override]
+        # A chaos-wrapped ring/two-shot/hier step is still shard-parallel:
+        # the build-time fusion gate must see the inner schedule.
+        return getattr(self.inner, "shard_parallel", False)
+
+    def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
+                          world: int, vote: bool = False) -> int:
+        # Fault injection moves no extra wire bytes — telemetry under chaos
+        # must price the INNER schedule (the base-class gather formula
+        # happened to match the allgather smoke config; a wrapped
+        # ring/hier would silently report gather-cost bytes).
+        return self.inner._recv_total_bytes(payload_nbytes, n_elems, world,
+                                            vote=vote)
+
+    def recv_link_bytes(self, payload_nbytes: int, n_elems: int, world: int,
+                        topology=None, vote: bool = False):
+        return self.inner.recv_link_bytes(payload_nbytes, n_elems, world,
+                                          topology=topology, vote=vote)
+
     def step(self, x: jax.Array, mem_state: State, comp_state: State,
              memory: Memory, compressor: Compressor, rng: jax.Array
              ) -> tuple[jax.Array, State, State]:

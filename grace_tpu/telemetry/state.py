@@ -68,6 +68,16 @@ FIELDS = (
                                     # fingerprint exchange + any repair
                                     # broadcast (also folded into wire_bytes
                                     # so effective bytes stay honest)
+    ("wire_bytes_ici", "first"),    # wire_bytes split by link class under
+    ("wire_bytes_dcn", "first"),    # the transform's Topology
+                                    # (Communicator.recv_link_bytes): flat
+                                    # communicators are all-ICI within one
+                                    # slice and all-DCN beyond it; the
+                                    # hierarchical comm reports a mixed
+                                    # split. ici + dcn == the exchange's
+                                    # wire_bytes (on audit steps the scalar
+                                    # additionally carries audit_bytes,
+                                    # which are not split by link)
 )
 
 FIELD_INDEX = {name: i for i, (name, _) in enumerate(FIELDS)}

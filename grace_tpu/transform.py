@@ -54,7 +54,7 @@ import optax
 from jax import lax
 
 from grace_tpu.core import (Communicator, Compressor, Memory, State,
-                            axis_size)
+                            Topology, axis_size)
 from grace_tpu.telemetry.scopes import STAGE_TELEMETRY, trace_stage
 from grace_tpu.telemetry.state import (TelemetryConfig, telemetry_init,
                                        telemetry_record)
@@ -297,7 +297,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     fusion: Optional[int | str] = None,
                     escape: Optional[Compressor] = None,
                     telemetry=None,
-                    consensus=None
+                    consensus=None,
+                    topology: Optional[Topology] = None
                     ) -> optax.GradientTransformation:
     """Build the compressed-exchange transformation.
 
@@ -368,6 +369,16 @@ def grace_transform(compressor: Compressor, memory: Memory,
     ``TelemetryConfig(compression_error=False)`` to make telemetry
     near-free.
 
+    ``topology`` (None | :class:`grace_tpu.core.Topology`): the mesh link
+    layout the telemetry ring prices its per-link wire split with — every
+    row's ``wire_bytes_ici``/``wire_bytes_dcn`` come from
+    ``Communicator.recv_link_bytes`` under this topology (flat
+    communicators therefore report the all-ICI split within one slice and
+    all-DCN beyond it; the hierarchical communicator reports a genuinely
+    mixed split). ``None`` auto-detects the live layout
+    (``Topology.detect()`` — a single slice on CPU/simulated meshes, which
+    is the documented all-ICI fallback for flat comms).
+
     ``consensus`` (None | True | int ``audit_every`` | dict |
     ``ConsensusConfig``): arm the cross-rank consistency auditor
     (:mod:`grace_tpu.resilience.consensus`) by threading an
@@ -397,8 +408,9 @@ def grace_transform(compressor: Compressor, memory: Memory,
             "(Allreduce/Allgather/Broadcast/SignAllreduce/Identity); "
             f"{type(communicator).__name__} re-chunks the gradient into "
             "per-rank shards inside step() (shard-parallel family: "
-            "TwoShotAllreduce/RingAllreduce), and vmapping its "
-            "all_to_all/ppermute schedule is not a traced path — use "
+            "TwoShotAllreduce/RingAllreduce/HierarchicalAllreduce), and "
+            "vmapping its all_to_all/ppermute schedule is not a traced "
+            "path — use "
             "fusion=None, 'flat', or integer byte buckets, which hand the "
             "communicator whole buffers to shard.")
     bucket_bytes = None if fusion == "flat" else fusion
@@ -553,16 +565,20 @@ def grace_transform(compressor: Compressor, memory: Memory,
             return 1
 
     def _wire_plan(leaves, world):
-        """(dense, recv, escape_recv) logical bytes for these leaves under
+        """(dense, link, escape_link) logical bytes for these leaves under
         the active fusion mode at world size ``world``. ``dense`` is the
         raw dense gradient bytes (the codec- and communicator-blind
-        reference); ``recv``/``escape_recv`` are COMMUNICATOR-AWARE bytes
-        received per rank per step (``Communicator.recv_wire_bytes``) —
-        payload bytes alone cannot rank e.g. ring/two-shot's O(k) against
-        allgather's O(W·k) received. Static Python ints, cached per
-        (leaf signature, world) — eval_shape tracing inside
-        ``payload_nbytes`` is a trace-time cost paid once per shape set,
-        never at run time. Same logical-vs-padded-bytes caveat as
+        reference); ``link``/``escape_link`` are COMMUNICATOR-AWARE
+        per-link :class:`~grace_tpu.core.LinkBytes` splits of the bytes
+        received per rank per step (``Communicator.recv_link_bytes`` under
+        the transform's topology; ``link.total`` is the scalar
+        ``recv_wire_bytes`` model) — payload bytes alone cannot rank e.g.
+        ring/two-shot's O(k) against allgather's O(W·k) received, and the
+        scalar alone cannot show that a flat schedule's bytes all ride DCN
+        beyond one slice. Static Python ints, cached per (leaf signature,
+        world) — eval_shape tracing inside ``payload_nbytes`` is a
+        trace-time cost paid once per shape set, never at run time. Same
+        logical-vs-padded-bytes caveat as
         :func:`grace_tpu.utils.metrics.wire_report`."""
         from grace_tpu.utils.metrics import payload_nbytes
 
@@ -576,19 +592,21 @@ def grace_transform(compressor: Compressor, memory: Memory,
         dense, comp_b, n_elems = fusion_payload_nbytes(
             compressor, structs, fusion)
         vote = bool(getattr(compressor, "vote_aggregate", False))
-        recv = communicator.recv_wire_bytes(comp_b, n_elems, world,
-                                            vote=vote)
+        topo = topology if topology is not None else Topology.detect()
+        link = communicator.recv_link_bytes(comp_b, n_elems, world,
+                                            topology=topo, vote=vote)
         if escape is not None:
             from grace_tpu.comm import Allreduce
             esc_b = sum(payload_nbytes(escape, s) for s in structs)
             # The escape hatch is a dense psum all-reduce of the escape
-            # payload — price it with the Allreduce ring model.
-            esc_recv = Allreduce(
-                axis_name=communicator.axis_name).recv_wire_bytes(
-                    esc_b, n_elems, world)
+            # payload — price it with the Allreduce ring model (a flat
+            # schedule: its split is all-ICI or all-DCN under ``topo``).
+            esc_link = Allreduce(
+                axis_name=communicator.axis_name).recv_link_bytes(
+                    esc_b, n_elems, world, topology=topo)
         else:
-            esc_recv = None
-        plan = _wire_plan_cache[(sig, world)] = (dense, recv, esc_recv)
+            esc_link = None
+        plan = _wire_plan_cache[(sig, world)] = (dense, link, esc_link)
         return plan
 
     def _sqsum(ls) -> jax.Array:
@@ -644,8 +662,10 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 "without telemetry (or restored from such a checkpoint). "
                 "Re-init the optimizer state with the telemetry-enabled "
                 "transform.")
-        dense_b, comp_b, esc_b = _wire_plan(
+        dense_b, link, esc_link = _wire_plan(
             leaves, _bound_axis_size(communicator.axis_name))
+        comp_b, esc_b = link.total, (
+            esc_link.total if esc_link is not None else None)
         grad_norm = jnp.sqrt(_sqsum(leaves))
         update_norm = jnp.sqrt(_sqsum(outs))
         mem_leaves = [l for l in jax.tree_util.tree_leaves(new_mem)
@@ -667,10 +687,20 @@ def grace_transform(compressor: Compressor, memory: Memory,
             err = jnp.zeros((), jnp.float32)
         if escape is None:
             eff = jnp.asarray(float(comp_b), jnp.float32)
+            eff_ici = jnp.asarray(float(link.ici), jnp.float32)
+            eff_dcn = jnp.asarray(float(link.dcn), jnp.float32)
         else:
-            eff = jnp.where(jnp.asarray(state.fallback, jnp.bool_),
-                            jnp.asarray(float(esc_b), jnp.float32),
+            fb = jnp.asarray(state.fallback, jnp.bool_)
+            eff = jnp.where(fb, jnp.asarray(float(esc_b), jnp.float32),
                             jnp.asarray(float(comp_b), jnp.float32))
+            # The per-link split flips with the scalar: a dense-fallback
+            # window's bytes ride the escape psum's flat schedule.
+            eff_ici = jnp.where(
+                fb, jnp.asarray(float(esc_link.ici), jnp.float32),
+                jnp.asarray(float(link.ici), jnp.float32))
+            eff_dcn = jnp.where(
+                fb, jnp.asarray(float(esc_link.dcn), jnp.float32),
+                jnp.asarray(float(link.dcn), jnp.float32))
         return telemetry_record(state.telem, state.count, {
             "grad_norm": grad_norm,
             "update_norm": update_norm,
@@ -683,6 +713,12 @@ def grace_transform(compressor: Compressor, memory: Memory,
             # Filled in after the fact by consensus_step on audit steps —
             # the audit runs post-apply, after this row is written.
             "audit_bytes": jnp.zeros((), jnp.float32),
+            # Per-link split of the exchange's wire_bytes under the
+            # transform's Topology; ici + dcn == wire_bytes on every
+            # non-audit step (the consensus hook folds its flat-collective
+            # audit cost into the scalar only).
+            "wire_bytes_ici": eff_ici,
+            "wire_bytes_dcn": eff_dcn,
         })
 
     def update(updates, state: GraceState, params=None):

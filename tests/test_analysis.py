@@ -238,7 +238,8 @@ def test_host_callback_fires():
 
 _COMMUNICATORS = [comm.Allreduce, comm.Allgather, comm.Broadcast,
                   comm.SignAllreduce, comm.TwoShotAllreduce,
-                  comm.RingAllreduce, comm.Identity]
+                  comm.RingAllreduce, comm.HierarchicalAllreduce,
+                  comm.Identity]
 
 
 @pytest.mark.parametrize("cls", _COMMUNICATORS,
@@ -289,6 +290,10 @@ _OLD_SCALAR = {
     comm.TwoShotAllreduce: lambda p, n, w, vote:
         2 * p * (w - 1) // max(1, w),
     comm.RingAllreduce: lambda p, n, w, vote:
+        2 * p * (w - 1) // max(1, w),
+    # Default-constructed (slice_size=None): one slice, so the two-level
+    # schedule — and therefore the model — collapses to the flat ring.
+    comm.HierarchicalAllreduce: lambda p, n, w, vote:
         2 * p * (w - 1) // max(1, w),
     comm.Identity: lambda p, n, w, vote: 0,
 }

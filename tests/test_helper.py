@@ -12,7 +12,8 @@ ALL_COMPRESSORS = ["none", "fp16", "bf16", "topk", "randomk", "threshold",
                    "onebit", "natural", "dgc", "powersgd", "u8bit", "sketch",
                    "adaq", "inceptionn"]
 ALL_MEMORIES = ["none", "residual", "efsignsgd", "dgc", "powersgd"]
-ALL_COMMUNICATORS = ["allreduce", "allgather", "broadcast", "identity"]
+ALL_COMMUNICATORS = ["allreduce", "allgather", "broadcast", "identity",
+                     "twoshot", "ring", "hier", "sign_allreduce"]
 
 
 @pytest.mark.parametrize("name", ALL_COMPRESSORS)

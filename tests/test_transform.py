@@ -85,6 +85,13 @@ CONFIGS = [
      "communicator": "ring"},
     {"compressor": "qsgd", "quantum_num": 64, "memory": "none",
      "communicator": "ring"},
+    # Two-level ICI×DCN schedule (ISSUE 7): slice_size=4 splits the
+    # 8-device mesh into 2 slices, so training runs through the intra-slice
+    # hop requants AND the slice-boundary re-encode + cross-slice vote/sum.
+    {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+     "communicator": "hier", "slice_size": 4},
+    {"compressor": "qsgd", "quantum_num": 64, "memory": "none",
+     "communicator": "hier", "slice_size": 4},
 ]
 
 

@@ -35,11 +35,19 @@ Exit status (for CI):
      smoke itself is broken), or --sdc corruption went undetected /
      replicas end diverged
 
+Hierarchical scenario (ISSUE 7): ``--hier`` swaps the communicator for the
+two-level ICI×DCN ``HierarchicalAllreduce`` (``--slice-size`` ranks per
+slice), so the guard's atomic rollback and the consensus repair are
+exercised over the nested grouped-collective exchange — and the telemetry
+artifact's ``wire_bytes_ici``/``wire_bytes_dcn`` rows carry the mixed
+per-link split.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py            # defaults
     python tools/chaos_smoke.py --steps 200 --nan-prob 0.01
     python tools/chaos_smoke.py --sdc                        # + param SDC
+    python tools/chaos_smoke.py --sdc --hier --slice-size 4  # hier matrix
 """
 
 from __future__ import annotations
@@ -86,6 +94,15 @@ def main(argv=None) -> int:
                          "GraceState footprint check) into the telemetry "
                          "artifact as perf_* events "
                          "(grace_tpu.profiling.ProfileRecorder)")
+    ap.add_argument("--hier", action="store_true",
+                    help="run the chaos matrix over the hierarchical "
+                         "ICI×DCN communicator (communicator='hier', "
+                         "fusion='flat') instead of allgather — "
+                         "guard rollback and consensus repair must stay "
+                         "atomic across the two-level grouped exchange")
+    ap.add_argument("--slice-size", type=int, default=4,
+                    help="with --hier: ranks per ICI slice (the 8-device "
+                         "mesh then spans 8/slice_size slices)")
     ap.add_argument("--lint", action="store_true",
                     help="first run graft-lint (repo rules + a static "
                          "audit of this smoke's own grace config); "
@@ -159,6 +176,17 @@ def main(argv=None) -> int:
                     # ring sized to the flush window so a healthy
                     # run never wraps between flushes
                     "telemetry": max(2 * args.telemetry_every, 16)}
+    if args.hier:
+        # Guard + consensus over the two-level ICI×DCN exchange: the NaN
+        # implant must propagate through the intra-slice ring AND the
+        # cross-slice grouped gather to every rank (or the guard's psum-OR
+        # desyncs), and the consensus repair must leave replicas
+        # bit-identical when the update itself was hierarchically
+        # aggregated. slice_size also flips the telemetry rows to the
+        # mixed wire_bytes_ici/wire_bytes_dcn split.
+        grace_params.update(communicator="hier",
+                            slice_size=args.slice_size,
+                            fusion="flat")
     grc = grace_from_params(grace_params)
     grc = dataclasses.replace(grc, communicator=ChaosCommunicator(
         inner=grc.communicator, nan_prob=args.nan_prob, rank=args.rank,

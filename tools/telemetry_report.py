@@ -30,8 +30,8 @@ from typing import List, Optional
 # Metric columns in display order; anything else numeric found in records
 # is appended after these.
 PREFERRED = ["grad_norm", "update_norm", "residual_norm", "residual_max",
-             "compression_error", "wire_bytes", "dense_bytes", "fallback",
-             "audit_bytes"]
+             "compression_error", "wire_bytes", "wire_bytes_ici",
+             "wire_bytes_dcn", "dense_bytes", "fallback", "audit_bytes"]
 
 
 def load(path: str):
@@ -139,6 +139,19 @@ def render(provenance, records, events,
                        f"{sum(wire) / max(sum(dense), 1):.4f} — "
                        "communicator-aware, so allgather at scale can "
                        "legitimately exceed 1.0)")
+            ici = [float(r["wire_bytes_ici"]) for r in records
+                   if "wire_bytes_ici" in r]
+            dcn = [float(r["wire_bytes_dcn"]) for r in records
+                   if "wire_bytes_dcn" in r]
+            if ici and dcn:
+                tot = sum(ici) + sum(dcn)
+                out.append(
+                    f"  per-link split: ici {int(sum(ici)):,d} B, "
+                    f"dcn {int(sum(dcn)):,d} B "
+                    f"({100.0 * sum(dcn) / max(tot, 1):.1f}% over DCN — "
+                    "flat communicators are all-ICI within one slice and "
+                    "all-DCN beyond it; a mixed split means the "
+                    "hierarchical two-level schedule)")
             wins = fallback_windows(records)
             if wins:
                 spans = ", ".join(f"{a}..{b}" for a, b in wins)
