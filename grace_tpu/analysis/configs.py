@@ -157,6 +157,27 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
                                     "memory": "residual",
                                     "communicator": "allgather",
                                     "fusion": "grouped"}),
+    # -- graft-watch variants (ISSUE 8): the watch summary adds a lax.cond
+    #    (window-boundary predicate from the replicated step counter) whose
+    #    taken branch issues an all_gather the untaken branch lacks — the
+    #    exact branch-divergent-collective shape pass 1 condemns when the
+    #    predicate is rank-varying, so these entries are the standing proof
+    #    it blesses the legal version. The non-escape entries keep
+    #    wire_reconciliation: the gather's (W-1)·12 B sit inside the
+    #    documented atol, pinning that the watch cost stays "tiny" — a
+    #    watch redesign that starts gathering big vectors every window
+    #    becomes a lint error, not a silent telemetry tax.
+    _cfg("topk-watch", {"compressor": "topk", "compress_ratio": 0.3,
+                        "memory": "residual", "communicator": "allgather",
+                        "telemetry": True, "watch": 5}),
+    _cfg("qsgd-ring-watch", {"compressor": "qsgd", "quantum_num": 64,
+                             "use_pallas": False, "memory": "none",
+                             "communicator": "ring", "fusion": "flat",
+                             "telemetry": True, "watch": 5}),
+    _cfg("hier-watch", {"compressor": "topk", "compress_ratio": 0.01,
+                        "topk_algorithm": "chunk", "memory": "residual",
+                        "communicator": "hier", "slice_size": 4,
+                        "fusion": "flat", "telemetry": True, "watch": 5}),
     # -- resilience variants: the conds the auditor exists for --------------
     _cfg("topk-escape-telemetry",
          {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
@@ -184,6 +205,16 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
           "topk_algorithm": "chunk", "memory": "residual",
           "communicator": "hier", "slice_size": 4, "fusion": "flat",
           "escape": "fp16", "consensus": True},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+    # The full observability+resilience stack in one trace: watch's gated
+    # gather, the escape cond, the guard's psum-OR and the consensus audit
+    # all nested in one train step — every replicated-predicate argument
+    # the system makes, verified together.
+    _cfg("watch-guard-consensus",
+         {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+          "communicator": "allgather", "escape": "fp16", "telemetry": True,
+          "watch": 5, "consensus": True},
          passes=_NO_WIRE, mode="train",
          guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
 ]

@@ -53,6 +53,10 @@ class Grace:
                            # with (wire_bytes_ici/wire_bytes_dcn). None =
                            # Topology.detect() at wire-plan time; set from
                            # params["slice_size"] by grace_from_params.
+    watch: Any = None      # None | True | window | dict | WatchConfig:
+                           # graft-watch in-graph cross-rank health
+                           # aggregation (grace_tpu.telemetry.aggregate);
+                           # requires telemetry.
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,
@@ -60,7 +64,8 @@ class Grace:
                                fusion=self.fusion, escape=self.escape,
                                telemetry=self.telemetry,
                                consensus=self.consensus,
-                               topology=self.topology)
+                               topology=self.topology,
+                               watch=self.watch)
 
 
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
@@ -205,4 +210,7 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
                  telemetry=params.get("telemetry"),
                  # True | audit_every | {"audit_every": .., "escalate_*": ..}
                  # — see grace_transform(consensus=) / resilience.consensus
-                 consensus=params.get("consensus"))
+                 consensus=params.get("consensus"),
+                 # True | window | {"window": .., "capacity": ..} — see
+                 # grace_transform(watch=) / telemetry.aggregate
+                 watch=params.get("watch"))

@@ -134,7 +134,11 @@ def grace_state_footprint(tree) -> Dict[str, int]:
             found += 1
             mem += _tree_nbytes(node.mem)
             comp += _tree_nbytes(node.comp)
-            telem += _tree_nbytes(node.telem)
+            # The graft-watch summary ring is telemetry state: per-rank
+            # sharded like the metric ring, world-independent row shape,
+            # so it scales with `world` in expected_state_footprint
+            # exactly like telem does.
+            telem += _tree_nbytes((node.telem, node.watch))
             book += _tree_nbytes((node.count, node.rng_key, node.fallback,
                                   node.audit))
         return node

@@ -93,12 +93,26 @@ class ChaosCompressor(Compressor):
     are per (step, leaf) — the rng handed to ``compress`` is already folded
     per step and leaf by ``grace_transform``, and ``seed`` decorrelates the
     fault stream from the codec's own randomness.
+
+    ``drift_scale`` models a *degrading encoder* instead of a corrupting
+    one: on the gated rank, every inexact payload lane is attenuated by
+    ``(1 - drift_scale)`` on every step — values stay perfectly finite
+    (the PR-1 guard is structurally blind) and the damage lands in
+    per-rank state (residuals/compression error are legitimately
+    per-rank, so the PR-3 consensus audit is blind by design). What it
+    *does* move is that rank's compression error and error-feedback
+    residual norm away from the fleet — exactly the single-rank skew
+    signal graft-watch (:mod:`grace_tpu.telemetry.aggregate`) exists to
+    flag first. Only meaningful for codecs whose payload carries value
+    lanes (topk/threshold/qsgd-style); sign-only payloads pass through
+    scaling unchanged in effect.
     """
 
     inner: Compressor
     nan_prob: float = 0.0
     inf_prob: float = 0.0
     bitflip_prob: float = 0.0
+    drift_scale: float = 0.0
     rank: Optional[int] = None
     axis_name: str = "data"
     seed: int = 0
@@ -153,6 +167,12 @@ class ChaosCompressor(Compressor):
                 hit = jax.random.bernoulli(khit, self.bitflip_prob) & gate
                 corrupted.append(jnp.where(hit, _flip_one_bit(t, kflip), t))
             payload = tuple(corrupted)
+        if self.drift_scale:
+            scale = jnp.where(gate, 1.0 - self.drift_scale, 1.0)
+            payload = tuple(
+                (t * jnp.asarray(scale, t.dtype)
+                 if jnp.issubdtype(t.dtype, jnp.inexact) else t)
+                for t in payload)
         return payload, ctx, new_state
 
 

@@ -168,14 +168,18 @@ def _grace_nodes(tree) -> list:
 
 
 def replicated_view(tree):
-    """``tree`` with the per-rank GraceState payloads (mem/comp/telem)
-    dropped: exactly the leaves that must be bit-identical across ranks —
-    params, downstream optimizer state, guard counters, and the replicated
-    GraceState scalars (count, rng_key, fallback, audit)."""
+    """``tree`` with the per-rank GraceState payloads (mem/comp/telem/
+    watch) dropped: exactly the leaves that must be bit-identical across
+    ranks — params, downstream optimizer state, guard counters, and the
+    replicated GraceState scalars (count, rng_key, fallback, audit). The
+    graft-watch ring is per-rank by design (its skew columns differ per
+    rank by construction), so fingerprinting it would read healthy skew as
+    divergence."""
 
     def strip(node):
         if _is_grace(node):
-            return node._replace(mem=None, comp=None, telem=None)
+            return node._replace(mem=None, comp=None, telem=None,
+                                 watch=None)
         return node
 
     return jax.tree_util.tree_map(strip, tree, is_leaf=_is_grace)

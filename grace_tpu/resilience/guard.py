@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from grace_tpu.telemetry.aggregate import WatchState
 from grace_tpu.telemetry.state import TelemetryState
 from grace_tpu.transform import set_fallback_flag
 
@@ -50,15 +51,16 @@ __all__ = ["GuardState", "guard_transform"]
 
 
 def _strip_telemetry(tree):
-    """Drop TelemetryState nodes: the ring is *observational* (it records
-    e.g. the norm of a poisoned gradient verbatim), so its contents must
-    never flip a step bad on their own — the pipeline values it mirrors
-    are already scanned directly. The ring still rolls back with the rest
-    of the inner state on a bad step, so poisoned rows never survive into
-    a flush."""
+    """Drop TelemetryState and graft-watch WatchState nodes: both rings are
+    *observational* (they record e.g. the norm — or the cross-rank skew —
+    of a poisoned gradient verbatim), so their contents must never flip a
+    step bad on their own — the pipeline values they mirror are already
+    scanned directly. The rings still roll back with the rest of the inner
+    state on a bad step, so poisoned rows never survive into a flush."""
+    observational = (TelemetryState, WatchState)
     return jax.tree_util.tree_map(
-        lambda n: None if isinstance(n, TelemetryState) else n,
-        tree, is_leaf=lambda n: isinstance(n, TelemetryState))
+        lambda n: None if isinstance(n, observational) else n,
+        tree, is_leaf=lambda n: isinstance(n, observational))
 
 
 class GuardState(NamedTuple):

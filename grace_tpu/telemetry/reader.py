@@ -1,13 +1,17 @@
 """Host-side telemetry drain: one device-to-host transfer per flush window.
 
-``TelemetryReader`` is the bridge between the in-graph ring buffer
+``TelemetryReader`` is the bridge between the in-graph ring buffers
 (:class:`~grace_tpu.telemetry.state.TelemetryState`, written on-device every
-step) and the host world of sinks. The contract that keeps telemetry off the
-hot path: the training loop calls :meth:`TelemetryReader.update` every step,
-but only every ``every``-th call flushes — and a flush is exactly **one**
-``jax.device_get`` of the bundled rings, step ids, and guard counters
-(pinned by ``tests/test_telemetry.py::test_flush_is_one_transfer_per_window``).
-Between flushes the loop never blocks on telemetry.
+step, and the graft-watch summary ring
+:class:`~grace_tpu.telemetry.aggregate.WatchState`, written on window
+boundaries) and the host world of sinks. The contract that keeps telemetry
+off the hot path: the training loop calls :meth:`TelemetryReader.update`
+every step, but only every ``every``-th call flushes — and a flush is
+exactly **one** ``jax.device_get`` of the bundled metric rings, watch
+rings, step ids, and guard counters (pinned by
+``tests/test_telemetry.py::test_flush_is_one_transfer_per_window`` and its
+watch-armed twin in ``tests/test_watch.py``). Between flushes the loop
+never blocks on telemetry.
 
 Semantics worth knowing:
 
@@ -23,7 +27,15 @@ Semantics worth knowing:
 * Works on either state layout: the global view (telemetry leaves carrying a
   leading world axis, as the train loop holds it) or the per-device view.
   Cross-rank aggregation follows each field's spec in
-  :data:`~grace_tpu.telemetry.state.FIELDS`.
+  :data:`~grace_tpu.telemetry.state.FIELDS`; graft-watch rows additionally
+  re-assemble their per-rank skew columns into W-vectors from the ring's
+  world axis (the host-side twin of the in-graph all_gather — see
+  :data:`~grace_tpu.telemetry.aggregate.WATCH_FIELDS`).
+* ``anomaly=...`` arms the streaming detectors
+  (:class:`~grace_tpu.telemetry.anomaly.WatchMonitor`): every flush's
+  records run through them and any ``watch_anomaly`` findings land in the
+  same sink, so the JSONL artifact carries the whole causal chain —
+  summary, anomaly, then (if things get worse) guard/consensus events.
 """
 
 from __future__ import annotations
@@ -33,6 +45,8 @@ from typing import Any, List, Optional
 import jax
 import numpy as np
 
+from grace_tpu.telemetry.aggregate import WATCH_FIELDS, WatchState
+from grace_tpu.telemetry.anomaly import AnomalyConfig, WatchMonitor
 from grace_tpu.telemetry.state import FIELDS, TelemetryState
 
 __all__ = ["TelemetryReader"]
@@ -61,6 +75,25 @@ def _aggregate(values: np.ndarray, agg: str) -> float:
     return float(values.mean())
 
 
+def _normalize_anomaly(anomaly, sink) -> Optional[WatchMonitor]:
+    """None/False (off), True (defaults), AnomalyConfig, dict of config
+    kwargs, or a ready WatchMonitor (its own sink wins if it has one)."""
+    if anomaly is None or anomaly is False:
+        return None
+    if isinstance(anomaly, WatchMonitor):
+        if anomaly.sink is None:
+            anomaly.sink = sink
+        return anomaly
+    if anomaly is True:
+        return WatchMonitor(sink=sink)
+    if isinstance(anomaly, AnomalyConfig):
+        return WatchMonitor(sink=sink, config=anomaly)
+    if isinstance(anomaly, dict):
+        return WatchMonitor(sink=sink, config=AnomalyConfig(**anomaly))
+    raise TypeError(f"anomaly must be None/bool/dict/AnomalyConfig/"
+                    f"WatchMonitor; got {type(anomaly).__name__}")
+
+
 class TelemetryReader:
     """Flush the on-device telemetry ring through a sink every N steps.
 
@@ -68,7 +101,7 @@ class TelemetryReader:
 
         reader = TelemetryReader(JSONLSink("run.jsonl",
                                            provenance=run_provenance("synthetic")),
-                                 every=20)
+                                 every=20, anomaly=True)
         for i, batch in enumerate(batches):
             state, loss = step(state, batch)
             reader.update(i, state)
@@ -76,14 +109,17 @@ class TelemetryReader:
         reader.close()
     """
 
-    def __init__(self, sink: Optional[Any] = None, every: int = 10):
+    def __init__(self, sink: Optional[Any] = None, every: int = 10,
+                 anomaly=None):
         if every < 1:
             raise ValueError(f"flush interval must be >= 1; got {every}")
         self.sink = sink
         self.every = every
         self.dropped = 0         # total steps lost to ring wraparound
         self.flushes = 0         # completed device-to-host transfers
+        self.monitor = _normalize_anomaly(anomaly, sink)
         self._last_step = -1     # newest step id already emitted
+        self._last_watch_step = -1
 
     def update(self, step: int, state) -> List[dict]:
         """Per-loop-iteration hook: flushes on every ``every``-th call."""
@@ -92,9 +128,15 @@ class TelemetryReader:
         return []
 
     def flush(self, state) -> List[dict]:
-        """Drain all unseen ring rows in ONE device-to-host transfer."""
+        """Drain all unseen ring rows in ONE device-to-host transfer.
+
+        Returns the new records in sink order: metric rows (step-ordered),
+        then graft-watch summary rows, then any ``watch_anomaly`` records
+        the armed detectors produced from this window.
+        """
         telems = _collect(state, lambda n: isinstance(n, TelemetryState))
-        if not telems:
+        watches = _collect(state, lambda n: isinstance(n, WatchState))
+        if not telems and not watches:
             return []
         from grace_tpu.resilience.guard import GuardState
         guards = _collect(state, lambda n: isinstance(n, GuardState))
@@ -103,6 +145,9 @@ class TelemetryReader:
         for t in telems:
             bundle.append(t.rings)
             bundle.append(t.steps)
+        for w in watches:
+            bundle.append(w.rings)
+            bundle.append(w.steps)
         guard_vals = None
         if guards:
             bundle.extend(getattr(guards[0], f) for f in _GUARD_FIELDS)
@@ -112,6 +157,8 @@ class TelemetryReader:
             guard_vals = {f"guard_{name}": int(v) for name, v in
                           zip(_GUARD_FIELDS, host[len(host) - len(_GUARD_FIELDS):])}
             host = host[:len(host) - len(_GUARD_FIELDS)]
+        watch_host = host[2 * len(telems):]
+        host = host[:2 * len(telems)]
 
         records: List[dict] = []
         newest = self._last_step
@@ -138,6 +185,7 @@ class TelemetryReader:
                 records.append(rec)
                 newest = max(newest, int(steps[slot]))
 
+        watch_records = self._watch_records(watches, watch_host)
         if records:
             expected = newest - self._last_step
             seen = len({r["step"] for r in records})
@@ -151,11 +199,52 @@ class TelemetryReader:
             if self.sink is not None:
                 for rec in records:
                     self.sink.write(rec)
-        elif guard_vals and self.sink is not None:
+        elif guard_vals and self.sink is not None and not watch_records:
             # No fresh rows (e.g. every accepted step already flushed, or
             # all steps in the window were skipped) — still surface guard
             # movement so a pathological run is not silent.
             self.sink.write({"event": "guard_only", **guard_vals})
+
+        if self.sink is not None:
+            for rec in watch_records:
+                self.sink.write(rec)
+        out = records + watch_records
+        if self.monitor is not None and out:
+            # Detector findings are written to the sink by the monitor
+            # itself (same funnel as everything else).
+            out = out + self.monitor.observe(out)
+        return out
+
+    def _watch_records(self, watches, watch_host) -> List[dict]:
+        """graft-watch summary rows from the flushed ring bundle:
+        replicated columns read once, per-rank ``gather`` columns
+        re-assembled into W-vectors from the ring's world axis."""
+        n_fields = len(WATCH_FIELDS)
+        records: List[dict] = []
+        newest = self._last_watch_step
+        for wi in range(len(watches)):
+            rings = np.asarray(watch_host[2 * wi])
+            steps = np.asarray(watch_host[2 * wi + 1])
+            if rings.shape[-1] != n_fields or rings.ndim < 2:
+                raise ValueError(
+                    f"watch ring has shape {rings.shape}; expected "
+                    f"(..., capacity, {n_fields}) — state layout mismatch")
+            rings = rings.reshape((-1,) + rings.shape[-2:])
+            steps = steps.reshape(-1, rings.shape[1])[0]   # replicated
+            fresh = np.flatnonzero(steps > self._last_watch_step)
+            for slot in fresh[np.argsort(steps[fresh])]:
+                rec: dict = {"event": "watch", "step": int(steps[slot])}
+                if len(watches) > 1:
+                    rec["watch_index"] = wi
+                for fi, (name, agg) in enumerate(WATCH_FIELDS):
+                    if agg == "gather":
+                        rec[name] = [float(v) for v in rings[:, slot, fi]]
+                    else:
+                        rec[name] = float(rings[0, slot, fi])
+                rec["skew_rank"] = int(rec["skew_rank"])
+                records.append(rec)
+                newest = max(newest, int(steps[slot]))
+        self._last_watch_step = newest
         return records
 
     def close(self) -> None:

@@ -29,7 +29,7 @@ __all__ = ["trace_stage", "match_stage", "ALL_STAGES",
            "STAGE_EXCHANGE", "STAGE_DECOMPRESS", "STAGE_MEMORY_UPDATE",
            "STAGE_FWD_BWD", "STAGE_OPTIMIZER", "STAGE_APPLY",
            "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS",
-           "STAGE_RING_HOP"]
+           "STAGE_RING_HOP", "STAGE_WATCH"]
 
 # Canonical stage names — one vocabulary for the profiler, the report tool,
 # and the docs. Keep in sync with README "Observability".
@@ -48,6 +48,11 @@ STAGE_CONSENSUS = "grace/consensus"
 # (ppermute + decompress + accumulate + requantize) renders as its own
 # "grace/ring_hop/<s>" span, so per-hop cost is attributable in a trace.
 STAGE_RING_HOP = "grace/ring_hop"
+# graft-watch cross-rank health aggregation (telemetry/aggregate.py): the
+# window-boundary all_gather of per-rank health vectors plus the summary
+# math — one attributable span so its (tiny) cost never hides inside the
+# telemetry scope it runs next to.
+STAGE_WATCH = "grace/watch"
 
 # The canonical stage vocabulary, longest-prefix-matchable: the profiler,
 # tools/telemetry_report.py, and the static auditor's finding attribution
@@ -58,7 +63,8 @@ STAGE_RING_HOP = "grace/ring_hop"
 ALL_STAGES = tuple(sorted(
     (STAGE_COMPENSATE, STAGE_COMPRESS, STAGE_EXCHANGE, STAGE_DECOMPRESS,
      STAGE_MEMORY_UPDATE, STAGE_FWD_BWD, STAGE_OPTIMIZER, STAGE_APPLY,
-     STAGE_TELEMETRY, STAGE_DENSE_ESCAPE, STAGE_CONSENSUS, STAGE_RING_HOP),
+     STAGE_TELEMETRY, STAGE_DENSE_ESCAPE, STAGE_CONSENSUS, STAGE_RING_HOP,
+     STAGE_WATCH),
     key=len, reverse=True))
 
 

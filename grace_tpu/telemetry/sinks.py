@@ -53,6 +53,19 @@ def _jsonable(value: Any) -> Any:
     return str(value)
 
 
+def _retry_io(fn, what: str):
+    """``checkpoint._retry_io`` (bounded-backoff retry of transient
+    ``OSError``s — the same policy ``Checkpointer.save`` uses, so a
+    preempted node's NFS blip can't drop the last window of records) when
+    available; single attempt on a box without orbax's dependency tree
+    (the bench.py fallback idiom)."""
+    try:
+        from grace_tpu.checkpoint import _retry_io as retry
+    except Exception:
+        return fn()
+    return retry(fn, what)
+
+
 class Sink:
     """Minimal structured-record sink contract."""
 
@@ -77,6 +90,13 @@ class JSONLSink(Sink):
     sink never touches the filesystem (a run that records nothing leaves
     nothing behind). ``rank_zero_only=True`` (default) makes non-zero
     processes no-ops.
+
+    Durability: every record is written whole + flushed under the
+    checkpoint save path's bounded-backoff ``_retry_io``, and ``close()``
+    fsyncs before releasing the fd — a chaos-killed or preempted run
+    leaves at worst a missing tail record, never a truncated mid-line one
+    (the timeline loader still skips a torn line defensively, but it
+    should never see one from this writer).
     """
 
     def __init__(self, path: str | os.PathLike,
@@ -103,8 +123,13 @@ class JSONLSink(Sink):
         return True
 
     def _emit(self, obj: Mapping[str, Any]) -> None:
-        self._file.write(json.dumps(obj, default=_jsonable) + "\n")
-        self._file.flush()
+        line = json.dumps(obj, default=_jsonable) + "\n"
+
+        def write():
+            self._file.write(line)
+            self._file.flush()
+
+        _retry_io(write, f"telemetry record -> {self.path}")
 
     def write(self, record: Mapping[str, Any]) -> None:
         if self._ensure_open():
@@ -112,8 +137,13 @@ class JSONLSink(Sink):
 
     def close(self) -> None:
         if self._file is not None:
-            self._file.close()
-            self._file = None
+            try:
+                _retry_io(lambda: (self._file.flush(),
+                                   os.fsync(self._file.fileno())),
+                          f"fsync {self.path}")
+            finally:
+                self._file.close()
+                self._file = None
         self._closed = True
 
 

@@ -78,6 +78,14 @@ FIELDS = (
                                     # wire_bytes (on audit steps the scalar
                                     # additionally carries audit_bytes,
                                     # which are not split by link)
+    ("watch_bytes", "first"),       # graft-watch health-gather wire cost
+                                    # this step (telemetry/aggregate.py):
+                                    # non-zero on window-boundary steps
+                                    # only, and — unlike audit_bytes —
+                                    # folded into wire_bytes AND the
+                                    # per-link split (the gather is a flat
+                                    # full-axis collective, priced by the
+                                    # same Topology as the exchange)
 )
 
 FIELD_INDEX = {name: i for i, (name, _) in enumerate(FIELDS)}

@@ -17,14 +17,16 @@ program — the reference's per-parameter Python loop over world_size × n_param
 decompressions (SURVEY.md §3.1 hot loop) disappears into the compiler.
 
 State layout: ``GraceState(count, rng_key, mem, comp, fallback, telem,
-audit)``
+audit, watch)``
 where ``mem``/``comp`` are tuples aligned with the flattened gradient leaves,
 ``fallback`` is the replicated resilience health flag (see
 ``grace_transform(escape=...)``), ``telem`` is the optional on-device
 telemetry ring (``grace_transform(telemetry=...)``; None when telemetry is
-off, so the default state is unchanged), and ``audit`` is the optional
+off, so the default state is unchanged), ``audit`` is the optional
 replicated consensus-audit bookkeeping (``grace_transform(consensus=...)``;
-see :mod:`grace_tpu.resilience.consensus`). The rng key is
+see :mod:`grace_tpu.resilience.consensus`), and ``watch`` is the optional
+per-rank graft-watch summary ring (``grace_transform(watch=...)``; see
+:mod:`grace_tpu.telemetry.aggregate`). The rng key is
 replicated across ranks, so per-(step, leaf) keys derived via ``fold_in`` are
 rank-identical — the explicit contract RandomK/PowerSGD rely on (the
 reference relied on global-seed side effects, grace_dl/dist/compressor/
@@ -55,7 +57,11 @@ from jax import lax
 
 from grace_tpu.core import (Communicator, Compressor, Memory, State,
                             Topology, axis_size)
-from grace_tpu.telemetry.scopes import STAGE_TELEMETRY, trace_stage
+from grace_tpu.telemetry.aggregate import (normalize_watch,
+                                           watch_gather_bytes, watch_init,
+                                           watch_record)
+from grace_tpu.telemetry.scopes import (STAGE_TELEMETRY, STAGE_WATCH,
+                                        trace_stage)
 from grace_tpu.telemetry.state import (TelemetryConfig, telemetry_init,
                                        telemetry_record)
 
@@ -106,6 +112,11 @@ class GraceState(NamedTuple):
     # where params and the whole optimizer state are in scope — see
     # grace_tpu.resilience.consensus.
     audit: Any = None
+    # graft-watch cross-rank health-summary ring (per-rank data, like
+    # telem — the skew columns genuinely differ per rank): a
+    # grace_tpu.telemetry.aggregate.WatchState when grace_transform was
+    # built with watch=..., else None (an empty pytree node).
+    watch: Any = None
 
 
 def _is_grace(x) -> bool:
@@ -113,14 +124,17 @@ def _is_grace(x) -> bool:
 
 
 def _map_grace_varying(fn, tree):
-    """Apply ``fn`` to the device-varying leaves (mem/comp/telem) of every
-    GraceState embedded in ``tree``; leave all other leaves untouched."""
+    """Apply ``fn`` to the device-varying leaves (mem/comp/telem/watch) of
+    every GraceState embedded in ``tree``; leave all other leaves
+    untouched."""
 
     def per_node(node):
         if _is_grace(node):
             return node._replace(mem=jax.tree_util.tree_map(fn, node.mem),
                                  comp=jax.tree_util.tree_map(fn, node.comp),
-                                 telem=jax.tree_util.tree_map(fn, node.telem))
+                                 telem=jax.tree_util.tree_map(fn, node.telem),
+                                 watch=jax.tree_util.tree_map(fn,
+                                                              node.watch))
         return node
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -167,7 +181,9 @@ def partition_specs(tree, axis_name: str):
                                                 node.fallback),
                 telem=jax.tree_util.tree_map(lambda _: P(axis_name),
                                              node.telem),
-                audit=jax.tree_util.tree_map(lambda _: P(), node.audit))
+                audit=jax.tree_util.tree_map(lambda _: P(), node.audit),
+                watch=jax.tree_util.tree_map(lambda _: P(axis_name),
+                                             node.watch))
         return jax.tree_util.tree_map(lambda _: P(), node)
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -298,7 +314,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     escape: Optional[Compressor] = None,
                     telemetry=None,
                     consensus=None,
-                    topology: Optional[Topology] = None
+                    topology: Optional[Topology] = None,
+                    watch=None
                     ) -> optax.GradientTransformation:
     """Build the compressed-exchange transformation.
 
@@ -388,8 +405,30 @@ def grace_transform(compressor: Compressor, memory: Memory,
     optimizer state are in scope for fingerprinting and repair. Any truthy
     value arms the state; the schedule/repair knobs are read from the
     config handed to the train step.
+
+    ``watch`` (None | True | int ``window`` | dict | ``WatchConfig``): arm
+    graft-watch (:mod:`grace_tpu.telemetry.aggregate`) — every
+    ``window``-th step all_gathers each rank's local health vector
+    (grad norm, compression error, residual norm) and writes a replicated
+    cross-rank mean/min/max summary plus the per-rank **skew** (deviation
+    from the replicated mean) into a bounded on-device ring
+    (``GraceState.watch``), gated by a ``lax.cond`` on the replicated step
+    counter exactly like the consensus audit. Costs one tiny collective
+    per window (``(W-1)·12`` B received per rank), folded honestly into
+    the telemetry row's ``wire_bytes``/``wire_bytes_ici``/
+    ``wire_bytes_dcn`` and surfaced as ``watch_bytes``. Requires
+    ``telemetry=...`` — the health scalars are the telemetry row's, and
+    without a ring there is nowhere to account the gather's wire cost.
     """
     telemetry = _normalize_telemetry(telemetry)
+    watch = normalize_watch(watch)
+    if watch is not None and telemetry is None:
+        raise ValueError(
+            "watch=... requires telemetry=...: graft-watch summarizes the "
+            "telemetry row's health scalars cross-rank and folds its "
+            "gather cost into the ring's wire_bytes — arm "
+            "grace_transform(telemetry=True) (or a capacity/config) "
+            "alongside watch.")
     consensus_armed = consensus is not None and consensus is not False
     if escape is not None and not (getattr(escape, "summable_payload", False)
                                    and escape.average):
@@ -445,7 +484,9 @@ def grace_transform(compressor: Compressor, memory: Memory,
                           fallback=jnp.zeros((), jnp.bool_),
                           telem=(telemetry_init(telemetry)
                                  if telemetry is not None else None),
-                          audit=audit_init() if consensus_armed else None)
+                          audit=audit_init() if consensus_armed else None,
+                          watch=(watch_init(watch)
+                                 if watch is not None else None))
 
     def _run_compressed(operand):
         leaves, mem, comp, step_key = operand
@@ -652,9 +693,12 @@ def grace_transform(compressor: Compressor, memory: Memory,
         return diff
 
     def _telemetry_next(state: GraceState, leaves, outs, new_mem, step_key):
-        """One telemetry row, written at slot count % capacity. Pure
+        """One telemetry row, written at slot count % capacity, plus the
+        maybe-updated graft-watch summary ring. The row itself is pure
         in-graph math over values the step already computed (plus the
-        optional codec round-trip) — no collectives, no host syncs."""
+        optional codec round-trip) — no collectives, no host syncs; the
+        watch summary (when armed) adds exactly one tiny all_gather on
+        window-boundary steps, whose wire cost is folded into this row."""
         if state.telem is None:
             raise ValueError(
                 "grace_transform was built with telemetry=... but the state "
@@ -701,7 +745,39 @@ def grace_transform(compressor: Compressor, memory: Memory,
             eff_dcn = jnp.where(
                 fb, jnp.asarray(float(esc_link.dcn), jnp.float32),
                 jnp.asarray(float(link.dcn), jnp.float32))
-        return telemetry_record(state.telem, state.count, {
+        new_watch = state.watch
+        wb = jnp.zeros((), jnp.float32)
+        if watch is not None:
+            if state.watch is None:
+                raise ValueError(
+                    "grace_transform was built with watch=... but the "
+                    "state has no watch ring — it was initialized by a "
+                    "transform without watch (or restored from such a "
+                    "checkpoint). Re-init the optimizer state with the "
+                    "watch-enabled transform.")
+            with trace_stage(STAGE_WATCH):
+                world = _bound_axis_size(communicator.axis_name)
+                due = jnp.equal(jnp.mod(state.count, watch.window), 0)
+                new_watch = watch_record(
+                    state.watch, state.count,
+                    {"grad_norm": grad_norm, "compression_error": err,
+                     "residual_norm": residual_norm},
+                    communicator.axis_name, due)
+                # Fold the gather's received bytes into the effective wire
+                # accounting — the same honesty contract as audit_bytes,
+                # but split by link too: the health gather is a flat
+                # full-axis collective, so it rides ICI within one slice
+                # and DCN beyond it, exactly like the escape psum.
+                topo = topology if topology is not None \
+                    else Topology.detect()
+                wb = jnp.where(due, jnp.asarray(
+                    float(watch_gather_bytes(world)), jnp.float32), 0.0)
+                eff = eff + wb
+                if topo.crosses_dcn(world):
+                    eff_dcn = eff_dcn + wb
+                else:
+                    eff_ici = eff_ici + wb
+        return new_watch, telemetry_record(state.telem, state.count, {
             "grad_norm": grad_norm,
             "update_norm": update_norm,
             "residual_norm": residual_norm,
@@ -716,9 +792,11 @@ def grace_transform(compressor: Compressor, memory: Memory,
             # Per-link split of the exchange's wire_bytes under the
             # transform's Topology; ici + dcn == wire_bytes on every
             # non-audit step (the consensus hook folds its flat-collective
-            # audit cost into the scalar only).
+            # audit cost into the scalar only; the watch gather is folded
+            # into scalar AND split, so the identity survives it).
             "wire_bytes_ici": eff_ici,
             "wire_bytes_dcn": eff_dcn,
+            "watch_bytes": wb,
         })
 
     def update(updates, state: GraceState, params=None):
@@ -737,15 +815,15 @@ def grace_transform(compressor: Compressor, memory: Memory,
             outs, new_mem, new_comp = lax.cond(
                 jnp.asarray(state.fallback, jnp.bool_),
                 _run_dense, _run_compressed, operand)
-        telem = state.telem
+        telem, watch_state = state.telem, state.watch
         if telemetry is not None:
             with trace_stage(STAGE_TELEMETRY):
-                telem = _telemetry_next(state, leaves, outs, new_mem,
-                                        step_key)
+                watch_state, telem = _telemetry_next(state, leaves, outs,
+                                                     new_mem, step_key)
         new_state = GraceState(count=state.count + 1, rng_key=state.rng_key,
                                mem=new_mem, comp=new_comp,
                                fallback=state.fallback, telem=telem,
-                               audit=state.audit)
+                               audit=state.audit, watch=watch_state)
         return jax.tree_util.tree_unflatten(treedef, outs), new_state
 
     return optax.GradientTransformation(init, update)

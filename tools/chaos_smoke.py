@@ -42,12 +42,25 @@ exercised over the nested grouped-collective exchange — and the telemetry
 artifact's ``wire_bytes_ici``/``wire_bytes_dcn`` rows carry the mixed
 per-link split.
 
+Watch scenario (ISSUE 8): ``--watch`` seeds a single-rank
+*compression-error drift* — ``ChaosCompressor(drift_scale=...)``
+attenuates one rank's payload values every step. The fault is perfectly
+finite (the guard is structurally blind: NaN injection is disabled in
+this mode and the smoke REQUIRES the guard to stay silent) and lives in
+per-rank state (the consensus audit is blind by design) — yet graft-watch
+(``grace_tpu.telemetry.aggregate`` + ``anomaly``) must flag the drifting
+rank with a ``watch_anomaly`` record in the artifact within one watch
+window, attributing the exact rank, before any guard/consensus event
+exists. Combine with ``--sdc`` to cross-validate: the consensus repair
+zeroes the SDC rank's residuals, which the watch skew detector also sees.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py            # defaults
     python tools/chaos_smoke.py --steps 200 --nan-prob 0.01
     python tools/chaos_smoke.py --sdc                        # + param SDC
     python tools/chaos_smoke.py --sdc --hier --slice-size 4  # hier matrix
+    python tools/chaos_smoke.py --watch --watch-rank 3       # drift watch
 """
 
 from __future__ import annotations
@@ -103,6 +116,22 @@ def main(argv=None) -> int:
     ap.add_argument("--slice-size", type=int, default=4,
                     help="with --hier: ranks per ICI slice (the 8-device "
                          "mesh then spans 8/slice_size slices)")
+    ap.add_argument("--watch", action="store_true",
+                    help="graft-watch scenario: seed a single-rank "
+                         "compression-error drift (finite — guard-blind; "
+                         "per-rank — consensus-blind) and require a "
+                         "watch_anomaly record naming that rank within "
+                         "one watch window. Disables NaN injection: the "
+                         "guard MUST stay silent, proving watch warns "
+                         "where guard/consensus cannot")
+    ap.add_argument("--watch-rank", type=int, default=3,
+                    help="mesh index whose encoder drifts (with --watch)")
+    ap.add_argument("--drift-scale", type=float, default=0.5,
+                    help="payload attenuation of the drifting rank "
+                         "(with --watch)")
+    ap.add_argument("--watch-window", type=int, default=10,
+                    help="steps between in-graph cross-rank health "
+                         "summaries (with --watch)")
     ap.add_argument("--lint", action="store_true",
                     help="first run graft-lint (repo rules + a static "
                          "audit of this smoke's own grace config); "
@@ -168,6 +197,15 @@ def main(argv=None) -> int:
                      else (args.steps // 3, 2 * args.steps // 3))
         sdc = ChaosParams(rank=args.sdc_rank, at_steps=sdc_steps,
                           seed=args.seed + 2)
+    if args.watch:
+        # The drift must be the ONLY fault: the scenario's claim is that
+        # watch flags a degradation the guard cannot see, so the guard
+        # staying silent is part of the assertion.
+        if args.nan_prob:
+            print("[chaos_smoke] --watch: disabling NaN injection "
+                  f"(nan_prob {args.nan_prob} -> 0.0) — the drift "
+                  "scenario requires a guard-silent run")
+        args.nan_prob = 0.0
     grace_params = {"compressor": "topk", "compress_ratio": 0.3,
                     "memory": "residual",
                     "communicator": "allgather",
@@ -176,6 +214,12 @@ def main(argv=None) -> int:
                     # ring sized to the flush window so a healthy
                     # run never wraps between flushes
                     "telemetry": max(2 * args.telemetry_every, 16)}
+    if args.watch:
+        grace_params["watch"] = {
+            "window": args.watch_window,
+            # summary ring sized so a flush window never wraps it
+            "capacity": max(2 * args.telemetry_every // args.watch_window,
+                            8)}
     if args.hier:
         # Guard + consensus over the two-level ICI×DCN exchange: the NaN
         # implant must propagate through the intra-slice ring AND the
@@ -191,6 +235,11 @@ def main(argv=None) -> int:
     grc = dataclasses.replace(grc, communicator=ChaosCommunicator(
         inner=grc.communicator, nan_prob=args.nan_prob, rank=args.rank,
         seed=args.seed + 1))
+    if args.watch:
+        from grace_tpu.resilience import ChaosCompressor
+        grc = dataclasses.replace(grc, compressor=ChaosCompressor(
+            inner=grc.compressor, drift_scale=args.drift_scale,
+            rank=args.watch_rank, seed=args.seed + 3))
     tx = guarded_chain(grc, optax.sgd(args.lr),
                        fallback_after=args.fallback_after,
                        fallback_steps=args.fallback_steps)
@@ -202,6 +251,11 @@ def main(argv=None) -> int:
 
     sink = None
     reader = None
+    if args.watch and not args.telemetry_out:
+        print("[chaos_smoke] --watch requires --telemetry-out: the "
+              "acceptance artifact IS the watch_anomaly record",
+              file=sys.stderr)
+        return 1
     if args.telemetry_out:
         sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
             data="synthetic",
@@ -210,7 +264,8 @@ def main(argv=None) -> int:
             nan_prob=args.nan_prob, steps=args.steps,
             fallback_after=args.fallback_after,
             fallback_steps=args.fallback_steps))
-        reader = TelemetryReader(sink, every=args.telemetry_every)
+        reader = TelemetryReader(sink, every=args.telemetry_every,
+                                 anomaly=args.watch)
     monitor = GuardMonitor(sink=sink)
     consensus_mon = ConsensusMonitor(sink=sink)
     profiler = None
@@ -300,7 +355,49 @@ def main(argv=None) -> int:
         print("[chaos_smoke] FAIL: final loss is non-finite — the guard did "
               "not contain the injected faults", file=sys.stderr)
         return 1
-    if rep["notfinite_count"] == 0:
+    if args.watch:
+        anomalies = reader.monitor.anomalies if reader.monitor else []
+        allowed = {args.watch_rank}
+        if sdc is not None:
+            # --sdc cross-validation: the consensus repair zeroes the SDC
+            # rank's residuals, a legitimate residual-skew the watch sees.
+            allowed.add(args.sdc_rank)
+        # Attribution is judged on the CODEC-HEALTH metrics the drift
+        # corrupts (compression error, residual norm). grad_norm skews are
+        # excluded from the misattribution check: this smoke feeds each
+        # rank a FIXED batch shard, so per-rank gradient-norm outliers are
+        # real data heterogeneity the detector is right to report.
+        fault_metrics = ("compression_error", "residual_norm")
+        skews = [a for a in anomalies if a.get("kind") == "skew"
+                 and a.get("metric") in fault_metrics]
+        hits = [a for a in skews if a.get("rank") == args.watch_rank]
+        wrong = [a for a in skews if a.get("rank") not in allowed]
+        first = min((a["step"] for a in hits), default=None)
+        print(f"[chaos_smoke] watch: {len(anomalies)} anomalies | "
+              f"rank-{args.watch_rank} codec-skew hits {len(hits)} "
+              f"(first at step {first}) | misattributed {len(wrong)}")
+        if rep["notfinite_count"] != 0:
+            print("[chaos_smoke] FAIL: guard tripped during the drift "
+                  "scenario — the fault is supposed to be finite and "
+                  "guard-invisible; the smoke itself is broken",
+                  file=sys.stderr)
+            return 1
+        if not hits:
+            print("[chaos_smoke] FAIL: seeded single-rank drift on rank "
+                  f"{args.watch_rank} produced no skew watch_anomaly for "
+                  "that rank", file=sys.stderr)
+            return 1
+        if wrong:
+            print(f"[chaos_smoke] FAIL: skew anomalies misattributed to "
+                  f"rank(s) {sorted({a['rank'] for a in wrong})}",
+                  file=sys.stderr)
+            return 1
+        if first > args.watch_window:
+            print(f"[chaos_smoke] FAIL: first rank-{args.watch_rank} "
+                  f"anomaly at step {first} — later than one watch window "
+                  f"({args.watch_window})", file=sys.stderr)
+            return 1
+    elif rep["notfinite_count"] == 0:
         print("[chaos_smoke] FAIL: guard never tripped — injection is not "
               "reaching the pipeline", file=sys.stderr)
         return 1

@@ -267,6 +267,27 @@ def build() -> str:
             f"Performance attribution: `perf_report --trace "
             f"{prof.get('trace', '?')}` → " + ", ".join(bits) +
             f" (`PROF_LAST.json`{', ' + when if when else ''}){note}.")
+    watch = _load("WATCH_LAST.json")
+    if isinstance(watch, dict) and watch.get("tool") == "graft_watch":
+        when = (watch.get("captured_at") or "").split("T")[0]
+        counts = watch.get("kind_counts") or {}
+        bits = [f"{watch.get('events', '?')} events "
+                f"({', '.join(f'{k} {v}' for k, v in sorted(counts.items()))})",
+                f"{watch.get('anomalies', 0)} anomaly record(s)"]
+        ranks = watch.get("anomalous_ranks")
+        if ranks:
+            bits.append(f"anomalous rank(s) {ranks} first flagged at step "
+                        f"{watch.get('first_anomaly_step')}")
+        regr = watch.get("regressions")
+        if regr is not None:
+            bits.append(f"{len(regr)} baseline regression(s)")
+        note = (" — seeded single-rank drift scenario, not a healthy run"
+                if ranks else "")
+        parts.append("")
+        parts.append(
+            f"Run health (graft-watch): `graft_watch "
+            f"{watch.get('artifact', '?')}` → " + ", ".join(bits) +
+            f" (`WATCH_LAST.json`{', ' + when if when else ''}){note}.")
     return "\n".join(parts).rstrip() + "\n"
 
 

@@ -8,16 +8,21 @@ header line followed by per-step metric records
 Output (text, stdout): the provenance block, a per-metric stats table
 (count / mean / min / max / last over the per-step records), wire-traffic
 accounting including dense-fallback windows reconstructed from the
-``fallback`` flag flips, a profiling section (step-time percentiles,
-compile/retrace events, memory watermarks, and the GraceState footprint
-check, from ``grace_tpu.profiling.ProfileRecorder``'s ``perf_*`` records),
-and the guard event log — one report covers one run. Pure stdlib — usable
-on any box that holds the artifact, no jax required.
+``fallback`` flag flips, a graft-watch section (cross-rank health
+summaries and ``watch_anomaly`` findings, from
+``grace_tpu.telemetry.aggregate``/``anomaly``), a profiling section
+(step-time percentiles, compile/retrace events, memory watermarks, and the
+GraceState footprint check, from ``grace_tpu.profiling.ProfileRecorder``'s
+``perf_*`` records), and the guard event log — one report covers one run.
+``--json`` emits the same content as one machine-readable document, so CI
+consumes structure instead of scraping text. Pure stdlib — usable on any
+box that holds the artifact, no jax required.
 
 Usage::
 
     python tools/telemetry_report.py chaos_telemetry.jsonl
     python tools/telemetry_report.py run.jsonl --metrics grad_norm,wire_bytes
+    python tools/telemetry_report.py run.jsonl --json
 """
 
 from __future__ import annotations
@@ -31,7 +36,8 @@ from typing import List, Optional
 # is appended after these.
 PREFERRED = ["grad_norm", "update_norm", "residual_norm", "residual_max",
              "compression_error", "wire_bytes", "wire_bytes_ici",
-             "wire_bytes_dcn", "dense_bytes", "fallback", "audit_bytes"]
+             "wire_bytes_dcn", "dense_bytes", "fallback", "audit_bytes",
+             "watch_bytes"]
 
 
 def load(path: str):
@@ -172,7 +178,13 @@ def render(provenance, records, events,
         out.append("  (none)")
 
     perf = [e for e in events if str(e.get("event", "")).startswith("perf_")]
-    other = [e for e in events if e not in perf]
+    watch = [e for e in events
+             if e.get("event") in ("watch", "watch_anomaly")]
+    other = [e for e in events if e not in perf and e not in watch]
+    if watch:
+        out.append("")
+        out.append("== watch (graft-watch summaries + anomalies) ==")
+        out.extend(_render_watch(watch))
     if perf:
         out.append("")
         out.append("== profiling (ProfileRecorder perf_* records) ==")
@@ -189,6 +201,47 @@ def render(provenance, records, events,
     if not other:
         out.append("  (none)")
     return "\n".join(out)
+
+
+def _render_watch(watch: List[dict]) -> List[str]:
+    """Cross-rank health summaries (one line per window) and anomaly
+    findings — the early-warning layer, rendered before the guard log it
+    is meant to preempt."""
+    out = []
+    summaries = [e for e in watch if e["event"] == "watch"]
+    anomalies = [e for e in watch if e["event"] == "watch_anomaly"]
+    if summaries:
+        out.append(f"  {len(summaries)} cross-rank summaries "
+                   f"(steps {summaries[0].get('step', '?')}"
+                   f"..{summaries[-1].get('step', '?')})")
+        worst = max(summaries, key=lambda e: e.get("skew_max", 0.0))
+        out.append(
+            f"  worst compression-error skew: {worst.get('skew_max', 0):.4g}"
+            f" (rank {worst.get('skew_rank', '?')} at step "
+            f"{worst.get('step', '?')}; relative to the cross-rank mean)")
+        last = summaries[-1]
+        for metric in ("grad_norm", "compression_error", "residual_norm"):
+            mean = last.get(f"{metric}_mean")
+            lo, hi = last.get(f"{metric}_min"), last.get(f"{metric}_max")
+            if mean is None:
+                continue
+            out.append(f"  last window {metric}: mean {mean:.6g} "
+                       f"(cross-rank min {lo:.6g} / max {hi:.6g})")
+    if anomalies:
+        out.append(f"  ANOMALIES ({len(anomalies)}):")
+        for a in anomalies:
+            rank = a.get("rank", -1)
+            who = f"rank {rank}" if isinstance(rank, int) and rank >= 0 \
+                else "fleet-wide"
+            out.append(
+                f"    step {a.get('step', '?'):>6}: "
+                f"{a.get('kind', '?')}/{a.get('metric', '?')} ({who}) "
+                f"score {a.get('score', 0):.3g} "
+                f"threshold {a.get('threshold', 0):.3g} "
+                f"value {a.get('value', 0):.4g}")
+    else:
+        out.append("  anomalies: none")
+    return out
 
 
 def _render_perf(perf: List[dict]) -> List[str]:
@@ -248,15 +301,59 @@ def _render_perf(perf: List[dict]) -> List[str]:
     return out
 
 
+def build_doc(provenance, records, events,
+              metrics: Optional[List[str]] = None) -> dict:
+    """Machine-readable twin of :func:`render` — the ``--json`` document
+    CI consumes instead of scraping the text layout."""
+    numeric = sorted({k for r in records for k, v in r.items()
+                      if isinstance(v, (int, float))
+                      and not isinstance(v, bool) and k != "step"})
+    cols = [m for m in (metrics or PREFERRED)
+            if any(m in r for r in records)]
+    cols += [k for k in numeric if k not in cols and metrics is None]
+    stats = {}
+    for m in cols:
+        vals = [float(r[m]) for r in records if m in r]
+        if vals:
+            stats[m] = _stats(vals)
+    steps = [r["step"] for r in records if "step" in r]
+    doc = {
+        "provenance": provenance,
+        "records": len(records),
+        "step_span": [min(steps), max(steps)] if steps else None,
+        "dropped_steps": sum(r.get("dropped_steps", 0) for r in records),
+        "metrics": stats,
+        "fallback_windows": [list(w) for w in fallback_windows(records)],
+        "guard_counters": ({k: records[-1][k] for k in sorted(records[-1])
+                            if k.startswith("guard_")} if records else {}),
+        "watch_summaries": [e for e in events if e.get("event") == "watch"],
+        "watch_anomalies": [e for e in events
+                            if e.get("event") == "watch_anomaly"],
+        "perf_events": [e for e in events
+                        if str(e.get("event", "")).startswith("perf_")],
+        "guard_events": [e for e in events
+                         if e.get("event") not in ("watch", "watch_anomaly")
+                         and not str(e.get("event", "")).startswith("perf_")],
+    }
+    return doc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("path", help="telemetry JSONL file (JSONLSink output)")
     ap.add_argument("--metrics", default=None,
                     help="comma-separated metric subset to summarize")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON document instead "
+                         "of the text report")
     args = ap.parse_args(argv)
     provenance, records, events = load(args.path)
     metrics = args.metrics.split(",") if args.metrics else None
-    print(render(provenance, records, events, metrics))
+    if args.json:
+        print(json.dumps(build_doc(provenance, records, events, metrics),
+                         indent=1))
+    else:
+        print(render(provenance, records, events, metrics))
     return 0 if (records or events) else 1
 
 
