@@ -30,10 +30,25 @@ to a jaxpr with **no devices** (``AbstractMesh`` + ``shard_map``, see
   of ``update`` (weak-type promotions / Python-scalar closure leaks force a
   retrace every step), and no host callbacks inside the compiled step.
 
+:mod:`.flow` (graft-flow, ISSUE 9) adds the dependence-graph layer — an
+equation-level DAG with ancestor closure and gradient-root tracking — and
+three passes on it: ``overlap_schedulability`` (static upper bound on the
+overlap fraction graft-prof measures + independent compress→exchange chain
+counting, condemning serialization points that defeat ``fusion=<bytes>``
+bucketing), ``numeric_safety`` (value-range abstract interpretation over
+payload dtypes: fp16 accumulation overflow at large W, vote-sum
+integer-exactness against :func:`grace_tpu.comm.vote_exact_max_world`,
+selection-index dtype and bit-pack width contracts), and
+``memory_footprint`` (eval_shape per-rank GraceState + wire-buffer
+accounting, the static twin of
+:func:`grace_tpu.profiling.grace_state_footprint`, flagging replicated
+O(W) buffers).
+
 :mod:`.rules` adds an AST-level repo rule engine (compressor capability
 declarations, telemetry FIELDS reducers, pytest marker registration);
-``tools/graft_lint.py`` is the CLI; ``tests/test_analysis.py`` is the CI
-gate, including deliberately seeded bad graphs proving each pass fires.
+``tools/graft_lint.py`` is the CLI; ``tests/test_analysis.py`` and
+``tests/test_flow.py`` are the CI gate, including deliberately seeded bad
+graphs proving each pass fires.
 """
 
 from grace_tpu.analysis.trace import (TracedGraph, abstract_mesh, trace_fn,
@@ -43,6 +58,12 @@ from grace_tpu.analysis.passes import (Finding, PASS_NAMES,
                                        pass_collective_consistency,
                                        pass_signature_stability,
                                        pass_wire_reconciliation, run_passes)
+from grace_tpu.analysis.flow import (DepGraph, DepNode, build_depgraph,
+                                     footprint_model, footprint_report,
+                                     overlap_summary,
+                                     pass_memory_footprint,
+                                     pass_numeric_safety,
+                                     pass_overlap_schedulability)
 from grace_tpu.analysis.configs import (AUDIT_CONFIGS, audit_all,
                                         audit_config, build_grace)
 from grace_tpu.analysis.rules import RULE_NAMES, run_repo_rules
@@ -55,6 +76,10 @@ __all__ = [
     "Finding", "PASS_NAMES", "run_passes",
     "pass_collective_consistency", "pass_bit_exactness",
     "pass_wire_reconciliation", "pass_signature_stability",
+    "DepGraph", "DepNode", "build_depgraph", "overlap_summary",
+    "footprint_model", "footprint_report",
+    "pass_overlap_schedulability", "pass_numeric_safety",
+    "pass_memory_footprint",
     "AUDIT_CONFIGS", "audit_all", "audit_config", "build_grace",
     "RULE_NAMES", "run_repo_rules",
     "findings_to_json", "render_text", "write_jsonl",

@@ -157,6 +157,17 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
                                     "memory": "residual",
                                     "communicator": "allgather",
                                     "fusion": "grouped"}),
+    # Int-bucket fusion (graft-flow, ISSUE 9): the 1024-byte plan splits
+    # the default params into K=2 buckets (w is 1920 B — its own bucket;
+    # b rides the second), so the overlap_schedulability pass verifies the
+    # traced graph actually exposes 2 independent compress→exchange chains
+    # — the schedulability contract ROADMAP item 2's chunked bucket
+    # scheduling will be built against.
+    _cfg("topk-allgather-bucketed", {"compressor": "topk",
+                                     "compress_ratio": 0.3,
+                                     "memory": "residual",
+                                     "communicator": "allgather",
+                                     "fusion": 1024}),
     # -- graft-watch variants (ISSUE 8): the watch summary adds a lax.cond
     #    (window-boundary predicate from the replicated step counter) whose
     #    taken branch issues an all_gather the untaken branch lacks — the

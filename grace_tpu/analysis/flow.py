@@ -1,0 +1,821 @@
+"""graft-flow: dependence-graph static analysis over traced configs.
+
+The four original graft-lint passes (:mod:`grace_tpu.analysis.passes`) walk
+the jaxpr equation-by-equation; none of them can answer *ordering*
+questions — what must wait on what. This module adds the dependence-graph
+layer: :func:`build_depgraph` flattens a
+:class:`~grace_tpu.analysis.trace.TracedGraph` into one equation-level DAG
+(ancestor bitsets over every nested cond/pjit/while body, gradient-root
+tracking seeded from the tracer's outer-argument map) and three passes ride
+on top of it:
+
+* ``overlap_schedulability`` — for every collective, the set of
+  data-independent compute equations is a **static upper bound** on the
+  overlap fraction graft-prof measures from device timelines
+  (:mod:`grace_tpu.profiling.trace_analysis` — measured can never exceed
+  what the dataflow permits, so ``measured > static bound`` means the
+  attribution is lying and is flagged). It also counts the *independent
+  compress→exchange chains* the exchange stage exposes: with
+  ``fusion=<bytes>`` bucketing the plan promises K buckets, and a graph
+  where one bucket's exchange transitively depends on another bucket's is
+  a serialization point XLA's latency-hiding scheduler cannot undo — the
+  forcing function for ROADMAP item 2's chunked bucket scheduling.
+* ``numeric_safety`` — value-range abstract interpretation over payload
+  dtypes: a per-rank payload term has unit multiplicity, hop sums and adds
+  accumulate multiplicities, ``psum``/grouped collectives multiply by the
+  ranks they span, and a float dtype whose accumulated term count exceeds
+  ``finfo(dtype).max / NUMERIC_UNIT_MAG`` is a silent-saturation finding
+  (fp16's 65504 cliff at W=4096; bf16 has no cliff and never fires). Vote
+  psums (the ``psum_vote`` trace scope) are checked against
+  :func:`grace_tpu.comm.vote_exact_max_world` — the same first-principles
+  constant the runtime guard in ``comm._psum_majority_vote`` enforces, so
+  the static pass and the runtime check can never disagree. Codec payload
+  contracts ride along: selection-index dtypes must address the fused leaf
+  sizes, and sub-byte bit-packing (:mod:`grace_tpu.ops.packing`) must
+  round-trip its declared widths.
+* ``memory_footprint`` — eval_shape-based per-rank accounting of the
+  GraceState rings (mem/comp/telem/bookkeeping, literally
+  :func:`grace_tpu.profiling.grace_state_footprint` — the static twin of
+  the recorder's live check) reconciled against the traced state
+  signature, plus peak wire-buffer accounting from the traced collective
+  outputs, flagging replicated state buffers whose shape scales with the
+  world size (per-rank O(W), fleet-wide O(W²)).
+
+All three register with :func:`grace_tpu.analysis.passes.run_passes` (the
+names appear in ``PASS_NAMES``; the module itself loads lazily to keep the
+import graph acyclic), run over the full config registry, and are proven
+live on seeded-bad graphs in ``tests/test_flow.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from grace_tpu.analysis.passes import (COLLECTIVE_PRIMS, Finding,
+                                       _REDUCTIONS, _aval_nbytes, _axes_of,
+                                       _group_size, _is_var, _stage_of,
+                                       _sub_jaxprs_of)
+from grace_tpu.analysis.trace import TracedGraph, default_param_structs
+from grace_tpu.telemetry.scopes import STAGE_EXCHANGE
+
+__all__ = ["DepNode", "DepGraph", "build_depgraph", "overlap_summary",
+           "footprint_report", "footprint_model", "safe_sum_terms",
+           "NUMERIC_UNIT_MAG", "OVERLAP_SLACK",
+           "pass_overlap_schedulability", "pass_numeric_safety",
+           "pass_memory_footprint"]
+
+FLOW_PASS_NAMES = ("overlap_schedulability", "numeric_safety",
+                   "memory_footprint")
+
+# Slack on the measured-vs-static overlap comparison: graft-prof's interval
+# unions carry trace-clock jitter and the static compute-cost proxy is
+# byte-weighted, so only a measured overlap that beats the static bound by
+# more than this is called a lie (same ±0.05 absolute band perf_report's
+# baseline gate uses for overlap regressions).
+OVERLAP_SLACK = 0.05
+
+# The documented per-term magnitude budget of the numeric-safety range
+# analysis: one rank's payload element is assumed bounded by this many
+# units. 256 covers every codec in the catalog with headroom (qsgd codes
+# are <= quantum_num <= 256 scaled by a norm the codec carries separately;
+# sign/vote terms are +-1; fp16/topk values are gradient-magnitude, and a
+# gradient element above 256 is already a divergence the guard owns). The
+# analysis is linear — accumulating W such terms reaches W*256 — so the
+# safe term count for a dtype is finfo.max / 256: ~255 for fp16 (the 65504
+# cliff), ~10^36 for fp32/bf16 (no cliff at any real W).
+NUMERIC_UNIT_MAG = 256.0
+
+
+def safe_sum_terms(dtype) -> Optional[int]:
+    """How many unit-magnitude payload terms a float dtype can accumulate
+    before overflowing: ``floor(finfo.max / NUMERIC_UNIT_MAG)``. None for
+    non-float dtypes (integer reductions are the bit-exactness pass's
+    sanctioned space — masked broadcasts deliberately sum W-1 zeros)."""
+    dt = np.dtype(dtype) if not hasattr(dtype, "itemsize") else dtype
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(dt, jnp.floating):
+        return None
+    return int(float(jnp.finfo(dt).max) / NUMERIC_UNIT_MAG)
+
+
+def _raw_stack(eqn) -> str:
+    try:
+        return str(eqn.source_info.name_stack)
+    except Exception:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# the dependence graph
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DepNode:
+    """One flattened equation. ``nbytes`` (total output bytes) is the cost
+    proxy both overlap weighting and wire-buffer accounting use; ``roots``
+    is a bitmask over the traced graph's gradient inputs this equation
+    transitively depends on."""
+
+    idx: int
+    prim: str
+    stage: str
+    nbytes: int
+    collective: bool
+    roots: int = 0
+
+
+@dataclasses.dataclass
+class DepGraph:
+    """Equation-level dependence DAG of one traced config.
+
+    ``anc[i]`` is a bitmask of node indices that are (transitive) ancestors
+    of node ``i`` — bitsets keep the reachability closure cheap enough to
+    build for every registered config in CI. Nested jaxprs (cond branches,
+    pjit bodies, unrolled ring hops) are flattened into the one graph, so
+    "independent" always means independent across the whole program, not
+    within one sub-jaxpr.
+    """
+
+    nodes: List[DepNode]
+    anc: List[int]
+    n_grad_roots: int
+
+    def is_ancestor(self, a: int, b: int) -> bool:
+        """True iff node ``a``'s output (transitively) feeds node ``b``."""
+        return bool((self.anc[b] >> a) & 1)
+
+
+def build_depgraph(traced: TracedGraph) -> DepGraph:
+    """Flatten the traced body into one dependence DAG.
+
+    Every equation of every nested jaxpr becomes a node; a node's ancestor
+    set is the union of its operands' def chains. Call-like equations
+    (``pjit``/``cond``/``while``/``custom_*``) are dissolved — their inner
+    equations join the global graph and the call's outputs carry the union
+    of the matching inner outputs' masks (conservative positional fallback
+    when arities disagree). Gradient roots are the tracer's ``grad_in``
+    vars, so ``roots`` says which gradient leaves each equation's value
+    descends from — the bucket-independence question.
+    """
+    nodes: List[DepNode] = []
+    anc: List[int] = []
+    grad_bit = {v: i for i, v in enumerate(traced.grad_in)}
+
+    env: Dict[Any, Tuple[int, int]] = {}
+    for v in traced.body.invars:
+        bit = grad_bit.get(v)
+        env[v] = (0, (1 << bit) if bit is not None else 0)
+    for v in getattr(traced.body, "constvars", ()):
+        env[v] = (0, 0)
+
+    def walk(jaxpr, env):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            in_anc = in_root = 0
+            for v in eqn.invars:
+                if _is_var(v) and v in env:
+                    a, r = env[v]
+                    in_anc |= a
+                    in_root |= r
+            subs = _sub_jaxprs_of(eqn)
+            if subs and name not in COLLECTIVE_PRIMS:
+                branch_outs = []
+                for sub in subs:
+                    ops = eqn.invars[1:] if name == "cond" else eqn.invars
+                    if len(sub.invars) == len(ops):
+                        sub_env = {
+                            sv: (env.get(ov, (0, 0)) if _is_var(ov)
+                                 else (0, 0))
+                            for sv, ov in zip(sub.invars, ops)}
+                    else:
+                        sub_env = {sv: (in_anc, in_root)
+                                   for sv in sub.invars}
+                    for cv in getattr(sub, "constvars", ()):
+                        sub_env[cv] = (0, 0)
+                    walk(sub, sub_env)
+                    branch_outs.append([
+                        sub_env.get(ov, (in_anc, in_root))
+                        if _is_var(ov) else (0, 0)
+                        for ov in sub.outvars])
+                for j, ov in enumerate(eqn.outvars):
+                    a, r = in_anc, in_root
+                    for outs in branch_outs:
+                        if len(outs) == len(eqn.outvars):
+                            a |= outs[j][0]
+                            r |= outs[j][1]
+                    env[ov] = (a, r)
+            else:
+                idx = len(nodes)
+                nbytes = sum(_aval_nbytes(v.aval) for v in eqn.outvars
+                             if hasattr(v, "aval"))
+                coll = (name in COLLECTIVE_PRIMS
+                        and traced.axis_name in _axes_of(eqn))
+                nodes.append(DepNode(idx=idx, prim=name,
+                                     stage=_stage_of(eqn), nbytes=nbytes,
+                                     collective=coll, roots=in_root))
+                anc.append(in_anc)
+                out = (in_anc | (1 << idx), in_root)
+                for ov in eqn.outvars:
+                    env[ov] = out
+
+    walk(traced.body, env)
+    return DepGraph(nodes=nodes, anc=anc,
+                    n_grad_roots=len(traced.grad_in))
+
+
+# ---------------------------------------------------------------------------
+# pass 5: overlap schedulability
+# ---------------------------------------------------------------------------
+
+def overlap_summary(traced: TracedGraph,
+                    graph: Optional[DepGraph] = None) -> Dict[str, Any]:
+    """The schedulability numbers for one traced config.
+
+    For every collective ``c``: the byte-cost of compute equations that are
+    neither ancestors nor descendants of ``c`` — the only work XLA's
+    latency-hiding scheduler is *allowed* to run under the exchange. The
+    per-collective bound ``min(1, independent_compute / collective_bytes)``
+    aggregates (collective-byte weighted) into ``static_overlap_bound``,
+    the static upper bound on graft-prof's measured overlap fraction.
+    ``independent_chains`` counts exchange-stage collectives with no other
+    exchange-stage collective as ancestor — the number of compress→exchange
+    chains the scheduler can actually interleave (a multi-phase schedule
+    like ring/two-shot is ONE chain: its phases share gradient roots and
+    chain by construction).
+    """
+    g = graph if graph is not None else build_depgraph(traced)
+    computes = [n for n in g.nodes if not n.collective and n.nbytes > 0]
+    colls = [n for n in g.nodes if n.collective]
+    total_compute = sum(n.nbytes for n in computes)
+    per = []
+    for c in colls:
+        indep = sum(n.nbytes for n in computes
+                    if not g.is_ancestor(c.idx, n.idx)
+                    and not g.is_ancestor(n.idx, c.idx))
+        cost = max(c.nbytes, 1)
+        per.append({"prim": c.prim, "stage": c.stage,
+                    "collective_bytes": c.nbytes,
+                    "independent_compute_bytes": indep,
+                    "bound": min(1.0, indep / cost)})
+    weight = sum(max(c.nbytes, 1) for c in colls)
+    bound = (sum(max(c.nbytes, 1) * p["bound"]
+                 for c, p in zip(colls, per)) / weight
+             if colls else None)
+    ex = [c for c in colls if c.stage == STAGE_EXCHANGE]
+    heads = [c for c in ex
+             if not any(g.is_ancestor(o.idx, c.idx)
+                        for o in ex if o is not c)]
+    return {"n_collectives": len(colls),
+            "exchange_collectives": len(ex),
+            "independent_chains": len(heads),
+            "total_compute_bytes": total_compute,
+            "static_overlap_bound": bound,
+            "per_collective": per}
+
+
+def _expected_chains(traced: TracedGraph) -> Optional[int]:
+    """How many independent compress→exchange chains the config promises:
+    the ``meta['expected_chains']`` override (seeded tests), else the
+    ``fusion=<bytes>`` bucketing plan's bucket count — the one fusion mode
+    whose entire purpose is exposing K independent chains (ROADMAP item
+    2's chunked bucket scheduling). Other fusion modes promise nothing
+    schedulability-shaped: 'flat' is deliberately one chain, per-leaf and
+    'grouped' derive their chain count from the model, not a knob."""
+    override = traced.meta.get("expected_chains")
+    if override is not None:
+        return int(override)
+    grace = traced.meta.get("grace")
+    if grace is None:
+        return None
+    fusion = getattr(grace, "fusion", None)
+    if not isinstance(fusion, int) or isinstance(fusion, bool):
+        return None
+    from grace_tpu.transform import _bucketize
+
+    structs = _param_structs(traced)
+    buckets, _ = _bucketize([(s.shape, s.dtype) for s in structs],
+                            int(fusion))
+    return len(buckets)
+
+
+def _param_structs(traced: TracedGraph) -> List[jax.ShapeDtypeStruct]:
+    leaves = traced.meta.get("param_structs")
+    if leaves is None:
+        return list(default_param_structs().values())
+    return jax.tree_util.tree_leaves(leaves)
+
+
+def pass_overlap_schedulability(traced: TracedGraph) -> List[Finding]:
+    """Two findings, both about what the scheduler is *allowed* to hide:
+
+    * **serialization point** — the config's bucketing plan promises K
+      independent compress→exchange chains but the traced graph exposes
+      fewer: some bucket's exchange transitively depends on another
+      bucket's, so the collectives issue back-to-back and the wire time
+      cannot hide under the remaining compute;
+    * **measured > statically possible** — when the trace is annotated with
+      graft-prof's measured overlap fraction (``meta['measured_overlap']``)
+      and it exceeds the dataflow's static upper bound by more than
+      :data:`OVERLAP_SLACK`, the measurement is attributing compute time to
+      collectives (or vice versa) — the profile pipeline is lying, not the
+      scheduler over-performing.
+    """
+    findings: List[Finding] = []
+    g = build_depgraph(traced)
+    s = overlap_summary(traced, graph=g)
+
+    expected = _expected_chains(traced)
+    if (expected is not None and expected > 1
+            and s["exchange_collectives"] >= expected
+            and s["independent_chains"] < expected):
+        findings.append(Finding(
+            pass_name="overlap_schedulability", config=traced.name,
+            severity="error", stage=STAGE_EXCHANGE,
+            message=(
+                f"bucketing promises {expected} independent "
+                "compress->exchange chains but the traced graph exposes "
+                f"only {s['independent_chains']} "
+                f"({s['exchange_collectives']} exchange collectives, the "
+                "rest transitively depend on another bucket's exchange) — "
+                "a serialization point XLA's latency-hiding scheduler "
+                "cannot undo; the buckets' wire time issues back-to-back "
+                "instead of overlapping the remaining compute"),
+            details=(("expected_chains", int(expected)),
+                     ("independent_chains", int(s["independent_chains"])),
+                     ("world", traced.world))))
+
+    measured = traced.meta.get("measured_overlap")
+    bound = s["static_overlap_bound"]
+    if (measured is not None and bound is not None
+            and float(measured) > bound + OVERLAP_SLACK):
+        findings.append(Finding(
+            pass_name="overlap_schedulability", config=traced.name,
+            severity="error", stage=STAGE_EXCHANGE,
+            message=(
+                f"measured overlap fraction {float(measured):.3f} exceeds "
+                f"the static upper bound {bound:.3f} (+{OVERLAP_SLACK} "
+                "slack) — the dataflow permits at most that much "
+                "data-independent compute under the collectives, so the "
+                "measured attribution (grace_tpu.profiling overlap "
+                "fraction) is misattributing spans, not the scheduler "
+                "over-performing; re-check the capture's stage scopes"),
+            details=(("measured_overlap", float(measured)),
+                     ("static_overlap_bound", round(bound, 6)),
+                     ("world", traced.world))))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 6: numeric-range safety
+# ---------------------------------------------------------------------------
+
+def _multiplicity_walk(traced: TracedGraph):
+    """Forward value-range dataflow: per-var accumulated payload-term
+    multiplicity. Seeds every real input at 1 (one rank's payload term),
+    constants at 0. Adds/subs sum multiplicities, cross-replica reductions
+    multiply by the ranks the collective spans (``axis_index_groups``
+    narrows it), ``reduce_sum`` multiplies by the reduced extent (the
+    gathered-partials-then-sum shape), everything else takes the max —
+    conservative for the linear-accumulation overflow class this pass
+    hunts, deliberately blind to multiplicative magnitude growth
+    (contractions, scales), which is a different failure mode the guard
+    owns at runtime. Returns (worst offender per float dtype, vote psum
+    records)."""
+    worst: Dict[str, Tuple[int, str]] = {}   # dtype -> (mult, stage)
+    votes: List[Tuple[str, str, int]] = []   # (dtype, stage, span)
+
+    def note(eqn, mult):
+        for ov in eqn.outvars:
+            aval = getattr(ov, "aval", None)
+            if aval is None:
+                continue
+            safe = safe_sum_terms(aval.dtype)
+            if safe is not None and mult > safe:
+                key = str(aval.dtype)
+                if key not in worst or mult > worst[key][0]:
+                    worst[key] = (mult, _stage_of(eqn))
+
+    def walk(jaxpr, env):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            ms = [env.get(v, 0) for v in eqn.invars if _is_var(v)]
+            m_in = max(ms, default=0)
+            if name in ("add", "sub", "add_any"):
+                out = sum(ms) if ms else 0
+            elif name in _REDUCTIONS and traced.axis_name in _axes_of(eqn):
+                span = _group_size(eqn, traced.world)
+                out = max(m_in, 1) * span
+                if "psum_vote" in _raw_stack(eqn):
+                    for v in eqn.invars:
+                        if _is_var(v):
+                            votes.append((str(v.aval.dtype),
+                                          _stage_of(eqn), span))
+            elif name == "reduce_sum":
+                shape = next((v.aval.shape for v in eqn.invars
+                              if _is_var(v)), ())
+                axes = eqn.params.get("axes", ())
+                factor = int(np.prod([shape[a] for a in axes
+                                      if a < len(shape)], dtype=np.int64)) \
+                    if axes else 1
+                out = m_in * max(factor, 1)
+            elif name == "convert_element_type":
+                # A cast into a (different) float dtype mints a FRESH
+                # payload term: the unit-magnitude budget is a statement
+                # about one rank's encoded wire value, so whatever f32
+                # arithmetic produced it (batch sums in the backward pass,
+                # mean reductions) is the codec's normalization problem,
+                # not cross-rank accumulation. Only sums OF the wire dtype
+                # — hop adds, psums, gathered-partial reductions — count
+                # against the dtype's saturation budget.
+                import jax.numpy as jnp
+                new_dtype = jnp.dtype(eqn.params.get("new_dtype"))
+                out = 1 if jnp.issubdtype(new_dtype, jnp.floating) \
+                    else m_in
+            elif name in ("dot_general", "conv_general_dilated"):
+                # Contractions grow magnitude multiplicatively, not by
+                # payload-term accumulation — out of this pass's model.
+                out = m_in
+            else:
+                subs = _sub_jaxprs_of(eqn)
+                if subs and name not in COLLECTIVE_PRIMS:
+                    branch_outs = []
+                    for sub in subs:
+                        ops = (eqn.invars[1:] if name == "cond"
+                               else eqn.invars)
+                        if len(sub.invars) == len(ops):
+                            sub_env = {sv: (env.get(ov, 0)
+                                            if _is_var(ov) else 0)
+                                       for sv, ov in zip(sub.invars, ops)}
+                        else:
+                            sub_env = {sv: m_in for sv in sub.invars}
+                        for cv in getattr(sub, "constvars", ()):
+                            sub_env[cv] = 0
+                        walk(sub, sub_env)
+                        branch_outs.append([
+                            sub_env.get(ov, m_in) if _is_var(ov) else 0
+                            for ov in sub.outvars])
+                    for j, ov in enumerate(eqn.outvars):
+                        m = m_in
+                        for outs in branch_outs:
+                            if len(outs) == len(eqn.outvars):
+                                m = max(m, outs[j])
+                        env[ov] = m
+                    note(eqn, max((max(o, default=0)
+                                   for o in branch_outs), default=m_in))
+                    continue
+                out = m_in
+            for ov in eqn.outvars:
+                env[ov] = out
+            note(eqn, out)
+
+    # Every body input (gradients, state, even hoisted constants) seeds at
+    # one payload term — a replicated value is still one magnitude unit,
+    # and over-seeding a constant only makes the bound more conservative.
+    env = {v: 1 for v in traced.body.invars}
+    for v in getattr(traced.body, "constvars", ()):
+        env[v] = 0
+    walk(traced.body, env)
+    return worst, votes
+
+
+def _codec_payload_structs(traced: TracedGraph):
+    """The (n_elems, struct) list the active fusion mode actually hands the
+    codec — mirrors :func:`grace_tpu.transform.fusion_payload_nbytes`'s
+    enumeration so the index-dtype check sees the fused leaf sizes, not the
+    raw per-parameter ones."""
+    import jax.numpy as jnp
+
+    from grace_tpu.transform import _bucketize, _group_views
+
+    grace = traced.meta.get("grace")
+    structs = _param_structs(traced)
+    fusion = getattr(grace, "fusion", None)
+    if fusion == "grouped":
+        reps = [structs[idxs[0]] for idxs in _group_views(structs)]
+    elif fusion is None:
+        reps = list(structs)
+    else:
+        bucket_bytes = None if fusion == "flat" else int(fusion)
+        buckets, cdtype = _bucketize([(s.shape, s.dtype) for s in structs],
+                                     bucket_bytes)
+        reps = [jax.ShapeDtypeStruct(
+            (sum(int(np.prod(structs[i].shape, dtype=np.int64))
+                 for i in idxs),), jnp.dtype(cdtype)) for idxs in buckets]
+    return [(int(np.prod(s.shape, dtype=np.int64)), s) for s in reps]
+
+
+def _index_dtype_findings(traced: TracedGraph) -> List[Finding]:
+    """Selection-index payloads must be able to address the fused leaf:
+    a signed-integer payload array *smaller than the leaf* is an index
+    table (Top-K/threshold selections; full-size integer arrays are
+    per-element codes and exempt), and its dtype's max must cover
+    ``n_elems - 1`` or decode scatters wrap silently."""
+    import jax.numpy as jnp
+
+    grace = traced.meta.get("grace")
+    if grace is None:
+        return []
+    findings: List[Finding] = []
+    for n_elems, struct in _codec_payload_structs(traced):
+        def encode(x):
+            rng = jax.random.key(0)     # shape-only trace
+            payload, _, _ = grace.compressor.compress(
+                x, grace.compressor.init_state(x), rng)
+            return payload
+
+        try:
+            payload = jax.eval_shape(encode, struct)
+        except Exception:               # e.g. in-compress collectives
+            continue
+        for leaf in jax.tree_util.tree_leaves(payload):
+            dt = jnp.dtype(leaf.dtype)
+            if not jnp.issubdtype(dt, jnp.signedinteger):
+                continue
+            size = int(np.prod(leaf.shape, dtype=np.int64))
+            if size >= n_elems:         # per-element codes, not indices
+                continue
+            if int(jnp.iinfo(dt).max) < n_elems - 1:
+                findings.append(Finding(
+                    pass_name="numeric_safety", config=traced.name,
+                    severity="error", stage="grace/compress",
+                    message=(
+                        f"{type(grace.compressor).__name__} ships a "
+                        f"{dt.name} index payload ({size} entries) for a "
+                        f"{n_elems}-element fused leaf, but "
+                        f"iinfo({dt.name}).max = {int(jnp.iinfo(dt).max)} "
+                        f"< {n_elems - 1} — top positions past the dtype's "
+                        "range wrap on decode and scatter into the wrong "
+                        "coordinates silently; widen the index dtype or "
+                        "shrink the fusion buckets"),
+                    details=(("index_dtype", dt.name),
+                             ("n_elems", int(n_elems)))))
+    return findings
+
+
+def _packing_findings(traced: TracedGraph, pack_fns=None) -> List[Finding]:
+    """Bit-pack width contract: when the codec ships a sub-byte packed
+    payload (an unsigned-byte array smaller than the element count), the
+    :mod:`grace_tpu.ops.packing` primitives it rides on must round-trip
+    their declared widths at boundary sizes and pack into exactly
+    ``ceil(n*width/8)`` bytes. ``pack_fns`` injects alternates for the
+    seeded-bad tests."""
+    import jax.numpy as jnp
+
+    grace = traced.meta.get("grace")
+    if grace is None:
+        return []
+    ships_packed = False
+    for n_elems, struct in _codec_payload_structs(traced):
+        def encode(x):
+            rng = jax.random.key(0)
+            payload, _, _ = grace.compressor.compress(
+                x, grace.compressor.init_state(x), rng)
+            return payload
+
+        try:
+            payload = jax.eval_shape(encode, struct)
+        except Exception:
+            continue
+        for leaf in jax.tree_util.tree_leaves(payload):
+            dt = jnp.dtype(leaf.dtype)
+            size = int(np.prod(leaf.shape, dtype=np.int64))
+            if jnp.issubdtype(dt, jnp.unsignedinteger) \
+                    and dt.itemsize == 1 and 0 < size < n_elems:
+                ships_packed = True
+    if not ships_packed:
+        return []
+    failures = (_packing_contract(pack_fns) if pack_fns is not None
+                else _packing_contract_cached())
+    return [Finding(
+        pass_name="numeric_safety", config=traced.name, severity="error",
+        stage="grace/compress", message=msg) for msg in failures]
+
+
+@functools.lru_cache(maxsize=1)
+def _packing_contract_cached() -> Tuple[str, ...]:
+    return _packing_contract(None)
+
+
+def _packing_contract(pack_fns) -> Tuple[str, ...]:
+    import jax.numpy as jnp
+
+    from grace_tpu.ops import packing
+
+    fns = pack_fns or packing.pack_widths()
+    out: List[str] = []
+    for width, pack, unpack in fns:
+        per_byte = 8 // width
+        for n in (1, per_byte - 1 or 1, per_byte, per_byte + 1, 64):
+            codes = np.full((n,), (1 << width) - 1, np.uint8)
+            packed = np.asarray(pack(jnp.asarray(codes)))
+            want = -(-n * width // 8)
+            if packed.size != want:
+                out.append(
+                    f"ops/packing: {width}-bit pack of {n} codes produced "
+                    f"{packed.size} bytes, expected ceil({n}*{width}/8) = "
+                    f"{want} — the wire-size model and every byte-count "
+                    "downstream of it are wrong")
+                continue
+            got = np.asarray(unpack(jnp.asarray(packed), n))
+            if not np.array_equal(got.astype(np.uint8), codes):
+                out.append(
+                    f"ops/packing: {width}-bit round-trip of max code "
+                    f"{(1 << width) - 1} over {n} lanes does not "
+                    "reconstruct — the declared pack width truncates "
+                    "in-range codes (silent payload corruption)")
+    return tuple(out)
+
+
+def pass_numeric_safety(traced: TracedGraph) -> List[Finding]:
+    """Value-range safety of the traced payload arithmetic — the
+    silent-saturation class a static pass catches before a chip runs:
+
+    * a float dtype accumulating more unit-magnitude payload terms than
+      ``finfo.max / NUMERIC_UNIT_MAG`` permits (hop sums, psums, grouped
+      gathers-then-sum) saturates to inf with no NaN for the guard to see
+      until downstream arithmetic manufactures one — fp16's 65504 cliff
+      falls at W≈256 and every flat psum of fp16 payloads beyond it;
+    * vote psums must stay integer-exact: ±1 sums in a dtype with p
+      mantissa bits are exact only up to ``2^(p+1)`` ranks
+      (:func:`grace_tpu.comm.vote_exact_max_world` — the constant the
+      runtime guard reads, re-derived from first principles in the tests);
+    * codec payload contracts: selection-index dtypes vs fused leaf sizes,
+      and bit-packing width round-trips (:func:`_packing_findings`).
+    """
+    from grace_tpu.comm import vote_exact_max_world
+
+    findings: List[Finding] = []
+    worst, votes = _multiplicity_walk(traced)
+    for dtype, (mult, stage) in sorted(worst.items()):
+        safe = safe_sum_terms(dtype)
+        findings.append(Finding(
+            pass_name="numeric_safety", config=traced.name,
+            severity="error", stage=stage,
+            message=(
+                f"{dtype} accumulation reaches {mult} payload terms at "
+                f"world={traced.world} but the dtype saturates at "
+                f"~{safe} terms of magnitude {NUMERIC_UNIT_MAG:g} "
+                f"(finfo({dtype}).max) — the sum overflows to inf with no "
+                "NaN for the guard to catch; accumulate in "
+                "float32/bfloat16 and downcast the final result, or cap "
+                "the schedule's span"),
+            details=(("dtype", dtype), ("terms", int(mult)),
+                     ("safe_terms", int(safe)), ("world", traced.world))))
+    seen = set()
+    for dtype, stage, span in votes:
+        bound = vote_exact_max_world(dtype)
+        if span > bound and (dtype, span) not in seen:
+            seen.add((dtype, span))
+            findings.append(Finding(
+                pass_name="numeric_safety", config=traced.name,
+                severity="error", stage=stage,
+                message=(
+                    f"majority-vote psum in {dtype} spans {span} ranks but "
+                    f"±1 vote sums are integer-exact only up to "
+                    f"{bound} (2^(mantissa+1) — "
+                    "comm.vote_exact_max_world, the same constant the "
+                    "runtime check enforces); beyond it vote tallies "
+                    "round and the election silently flips — use "
+                    "vote_dtype='float32'"),
+                details=(("vote_dtype", dtype), ("span", int(span)),
+                         ("exact_max_world", int(bound)))))
+    findings.extend(_index_dtype_findings(traced))
+    findings.extend(_packing_findings(traced))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 7: HBM footprint
+# ---------------------------------------------------------------------------
+
+def footprint_model(grace, params, world: int = 1) -> Dict[str, int]:
+    """The config's expected per-rank GraceState bytes scaled to ``world``
+    — literally :func:`grace_tpu.profiling.expected_state_footprint`, so
+    the static pass and the runtime recorder can never disagree about what
+    a config should weigh."""
+    from grace_tpu.profiling.recorder import expected_state_footprint
+
+    return expected_state_footprint(grace, params, world=world)
+
+
+def footprint_report(traced: TracedGraph) -> Dict[str, Any]:
+    """Per-rank peak accounting of one traced config: the GraceState rings
+    grouped exactly like :func:`grace_tpu.profiling.grace_state_footprint`
+    (mem / comp / telem+watch / bookkeeping, from the traced state
+    signature's avals) plus the wire buffers the collectives materialize
+    (``wire_peak_bytes`` — the largest single collective output per rank,
+    e.g. an all_gather's (W, k) stack; ``wire_total_bytes`` — every
+    collective output summed, an upper bound when XLA frees eagerly)."""
+    mem = comp = telem = book = 0
+    for path, aval in traced.state_in:
+        head = path.split("/", 1)[0]
+        n = _aval_nbytes(aval)
+        if head == "mem":
+            mem += n
+        elif head == "comp":
+            comp += n
+        elif head in ("telem", "watch"):
+            telem += n
+        else:
+            book += n
+
+    peak = total = n_coll = 0
+
+    def walk(jaxpr):
+        nonlocal peak, total, n_coll
+        for eqn in jaxpr.eqns:
+            if (eqn.primitive.name in COLLECTIVE_PRIMS
+                    and traced.axis_name in _axes_of(eqn)):
+                n = sum(_aval_nbytes(v.aval) for v in eqn.outvars
+                        if hasattr(v, "aval"))
+                peak = max(peak, n)
+                total += n
+                n_coll += 1
+            for sub in _sub_jaxprs_of(eqn):
+                walk(sub)
+
+    walk(traced.body)
+    return {"mem_bytes": mem, "comp_bytes": comp, "telem_bytes": telem,
+            "bookkeeping_bytes": book,
+            "state_total_bytes": mem + comp + telem + book,
+            "wire_peak_bytes": peak, "wire_total_bytes": total,
+            "n_collectives": n_coll}
+
+
+def pass_memory_footprint(traced: TracedGraph) -> List[Finding]:
+    """Per-rank HBM accounting findings:
+
+    * **replicated O(W) state** — a replicated (``P()``) state leaf with a
+      dimension equal to the world size costs O(W) per rank on every rank
+      (O(W²) fleet-wide) and grows every time the job scales — the buffer
+      class that should be sharded or windowed instead;
+    * **state-model mismatch** — the traced state signature's bytes must
+      equal the config's own ``eval_shape(init)`` footprint
+      (:func:`grace_tpu.profiling.grace_state_footprint`'s static twin); a
+      mismatch means the trace ran under a different codec/fusion/
+      telemetry config than the one being audited, the same bug class the
+      recorder's live ``grace_state_footprint`` check catches at run time.
+    """
+    findings: List[Finding] = []
+    for path, aval in traced.state_replicated:
+        shape = tuple(getattr(aval, "shape", ()))
+        if traced.world >= 4 and any(d == traced.world for d in shape):
+            findings.append(Finding(
+                pass_name="memory_footprint", config=traced.name,
+                severity="error",
+                message=(
+                    f"replicated state leaf '{path}' has shape {shape} "
+                    f"with a dimension equal to the world size "
+                    f"({traced.world}) — a replicated buffer that scales "
+                    "with W costs O(W) HBM per rank on EVERY rank (O(W²) "
+                    "fleet-wide) and grows each time the job scales; "
+                    "shard it over the axis (partition_specs P(axis)) or "
+                    "reduce it to a windowed summary"),
+                details=(("path", path), ("shape", tuple(map(int, shape))),
+                         ("world", traced.world))))
+
+    grace = traced.meta.get("grace")
+    if grace is not None and traced.state_in:
+        from grace_tpu.profiling.recorder import grace_state_footprint
+
+        params = traced.meta.get("param_structs")
+        if params is None:
+            params = default_param_structs()
+        try:
+            tx = grace.transform(seed=0)
+            model = grace_state_footprint(jax.eval_shape(tx.init, params))
+        except Exception:
+            model = None
+        if model is not None:
+            rep = footprint_report(traced)
+            for key in ("mem_bytes", "comp_bytes", "telem_bytes"):
+                if rep[key] != model[key]:
+                    findings.append(Finding(
+                        pass_name="memory_footprint", config=traced.name,
+                        severity="error",
+                        message=(
+                            f"traced state carries {rep[key]} B of "
+                            f"{key.split('_')[0]} state but the config's "
+                            f"own eval_shape(init) model says {model[key]} "
+                            "B — the trace ran under a different "
+                            "codec/fusion/telemetry config than the one "
+                            "being audited (the static twin of the "
+                            "recorder's grace_state_footprint check)"),
+                        details=(("component", key),
+                                 ("traced_bytes", int(rep[key])),
+                                 ("model_bytes", int(model[key])))))
+                    break
+    return findings
+
+
+PASS_FNS = {
+    "overlap_schedulability": pass_overlap_schedulability,
+    "numeric_safety": pass_numeric_safety,
+    "memory_footprint": pass_memory_footprint,
+}

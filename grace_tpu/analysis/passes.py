@@ -50,8 +50,14 @@ _CALLBACK_PRIMS = frozenset({
     "io_callback", "debug_callback", "pure_callback", "callback",
     "outside_call", "host_callback_call"})
 
+# The last three (graft-flow, ISSUE 9) live in analysis/flow.py on the
+# dependence-graph layer and are resolved lazily by run_passes — the names
+# are plain strings here so config registration and CLI selection never
+# import flow (which imports this module) at module-load time.
 PASS_NAMES = ("collective_consistency", "bit_exactness",
-              "wire_reconciliation", "signature_stability")
+              "wire_reconciliation", "signature_stability",
+              "overlap_schedulability", "numeric_safety",
+              "memory_footprint")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -637,10 +643,22 @@ _PASS_FNS = {
 }
 
 
+def _resolve_pass(name: str):
+    """Pass function by name; loads the graft-flow module on first use of
+    one of its passes (flow imports this module, so eager registration
+    would be a cycle)."""
+    fn = _PASS_FNS.get(name)
+    if fn is None:
+        from grace_tpu.analysis import flow
+        _PASS_FNS.update(flow.PASS_FNS)
+        fn = _PASS_FNS[name]
+    return fn
+
+
 def run_passes(traced: TracedGraph,
                passes: Optional[Tuple[str, ...]] = None) -> List[Finding]:
-    """Run the named passes (default: all four) over one traced graph."""
+    """Run the named passes (default: all seven) over one traced graph."""
     out: List[Finding] = []
     for name in (passes if passes is not None else PASS_NAMES):
-        out.extend(_PASS_FNS[name](traced))
+        out.extend(_resolve_pass(name)(traced))
     return out

@@ -72,9 +72,14 @@ class TracedGraph:
     ``varying`` maps each body input var to whether it carries rank-varying
     data (the replication-analysis seed). ``state_in``/``state_out`` are
     aligned (path, aval) lists for the optimizer-state portion of the
-    signature — the fixed-point check of ``signature_stability``. ``meta``
-    carries whatever the config registry wants findings to report
-    (compressor/communicator names, the Grace bundle for the wire model).
+    signature — the fixed-point check of ``signature_stability``.
+    ``grad_in`` lists the body invars carrying gradient (or batch) leaves —
+    the dependence-graph layer's bucket roots (:mod:`.flow`); and
+    ``state_replicated`` the (path, aval) state leaves whose partition spec
+    is ``P()`` — the buffers the memory-footprint pass checks for
+    world-scaling shapes. ``meta`` carries whatever the config registry
+    wants findings to report (compressor/communicator names, the Grace
+    bundle for the wire model).
     """
 
     name: str
@@ -85,6 +90,9 @@ class TracedGraph:
     varying: Dict[Any, bool]
     state_in: List[Tuple[str, Any]] = dataclasses.field(default_factory=list)
     state_out: List[Tuple[str, Any]] = dataclasses.field(default_factory=list)
+    grad_in: List[Any] = dataclasses.field(default_factory=list)
+    state_replicated: List[Tuple[str, Any]] = dataclasses.field(
+        default_factory=list)
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
@@ -253,9 +261,15 @@ def trace_fn(fn, args: Sequence[Any], *, world: int = 8,
                          f"{len(flat)} flattened arg leaves")
     seeds = _seeds_from_positions(positions, mask, len(body.invars))
     var_map = dict(zip(body.invars, seeds))
+    # Every outer-argument-carrying invar is a dependence root for the
+    # low-level entry (the seeded-bad-graph tests treat each arg as one
+    # "gradient bucket"); hoisted constants and computed values are not.
+    grad_in = ([v for v, p in zip(body.invars, positions)
+                if isinstance(p, int)]
+               if positions is not None else list(body.invars))
     return TracedGraph(name=name, closed=closed, body=body, world=world,
                        axis_name=axis_name, varying=var_map,
-                       meta=dict(meta or {}))
+                       grad_in=grad_in, meta=dict(meta or {}))
 
 
 def trace_update(grace, *, world: int = 8, params=None,
@@ -291,10 +305,11 @@ def trace_update(grace, *, world: int = 8, params=None,
         raise ValueError("no shard_map equation found in the traced update")
     inner, positions = found
 
-    mask = (_varying_mask_from_specs(state_struct, axis_name)
-            + [True] * len(grads_flat))
+    state_mask = _varying_mask_from_specs(state_struct, axis_name)
+    mask = state_mask + [True] * len(grads_flat)
     seeds = _seeds_from_positions(positions, mask, len(inner.invars))
     state_in = []
+    grad_in = []
     if positions is not None:
         # Body invar carrying outer arg leaf i (hoisted constants shift
         # the real arguments, so positional zip is not enough).
@@ -306,6 +321,12 @@ def trace_update(grace, *, world: int = 8, params=None,
                     if i in arg_to_body]
         if len(state_in) != len(paths):          # a state leaf went missing
             state_in = []
+        grad_in = [inner.invars[b] for i, b in sorted(arg_to_body.items())
+                   if i >= len(state_flat)]
+    # Replicated-by-contract state leaves (spec P()): the buffers the
+    # memory-footprint pass checks for world-scaling shapes.
+    state_replicated = [(p, a) for (p, a), varies
+                        in zip(state_in, state_mask) if not varies]
     var_map = dict(zip(inner.invars, seeds))
 
     # Body outputs are (updates..., new_state...): the state signature the
@@ -319,6 +340,7 @@ def trace_update(grace, *, world: int = 8, params=None,
     return TracedGraph(name=name, closed=closed, body=inner, world=world,
                        axis_name=axis_name, varying=var_map,
                        state_in=state_in, state_out=state_out,
+                       grad_in=grad_in, state_replicated=state_replicated,
                        meta=dict(meta or {}))
 
 
@@ -376,6 +398,12 @@ def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
             + [True] * len(batch_flat))
     seeds = _seeds_from_positions(positions, mask, len(inner.invars))
     var_map = dict(zip(inner.invars, seeds))
+    grad_in = []
+    if positions is not None:
+        arg_to_body = {i: p for p, i in enumerate(positions)
+                       if isinstance(i, int)}
+        grad_in = [inner.invars[b] for i, b in sorted(arg_to_body.items())
+                   if i >= len(state_flat)]
     return TracedGraph(name=name, closed=closed, body=inner, world=world,
                        axis_name=axis_name, varying=var_map,
-                       meta=dict(meta or {}))
+                       grad_in=grad_in, meta=dict(meta or {}))

@@ -33,8 +33,31 @@ from grace_tpu.telemetry.scopes import (STAGE_DECOMPRESS, STAGE_EXCHANGE,
 
 __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
            "SignAllreduce", "TwoShotAllreduce", "RingAllreduce",
-           "HierarchicalAllreduce",
+           "HierarchicalAllreduce", "vote_exact_max_world",
            "masked_broadcast", "masked_broadcast_tree"]
+
+
+def vote_exact_max_world(vote_dtype) -> int:
+    """Largest world size whose ±1 majority-vote sums stay integer-exact
+    in ``vote_dtype`` — the declared numeric contract of the psum-vote
+    routing, derived from first principles rather than hardcoded: a float
+    with p explicit mantissa bits represents every integer up to
+    ``2^(p+1)`` exactly (p stored bits plus the implicit leading one), and
+    a W-rank vote tally lives in ``[-W, W]``, so the sum is exact iff
+    ``W <= 2^(p+1)``. bfloat16 (p=7) gives the famous 256; float16 (p=10)
+    gives 2048; float32 (p=23) gives 16,777,216.
+
+    ONE constant, two enforcement points: the runtime check in
+    ``_psum_majority_vote`` raises past the bound on a live mesh, and the
+    static auditor's ``numeric_safety`` pass
+    (:mod:`grace_tpu.analysis.flow`) re-verifies every traced vote psum
+    against the same function — the bound can never drift between the
+    docstring, the runtime guard, and the lint gate.
+    """
+    dt = jnp.dtype(vote_dtype)
+    if not jnp.issubdtype(dt, jnp.floating):
+        raise TypeError(f"vote_dtype must be a float dtype; got {dt.name}")
+    return int(2 ** (jnp.finfo(dt).nmant + 1))
 
 
 # XLA-TPU layout pathology guard (observed on BERT-base, 2026-08-01): a
@@ -67,12 +90,13 @@ def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
     """Decompress this rank's ±1 signs, psum, re-sign: exact majority vote
     at fixed (world-size-independent) collective cost — SURVEY.md §7 hard
     part 4. Shared by SignAllreduce and the Allreduce vote routing."""
-    if vote_dtype == "bfloat16":
-        w = axis_size(axis_name)       # static at trace time
-        if w > 256:
-            raise ValueError(
-                f"vote_dtype='bfloat16' is integer-exact only up to world "
-                f"size 256; this axis has {w} — use vote_dtype='float32'.")
+    w = axis_size(axis_name)           # static at trace time
+    bound = vote_exact_max_world(vote_dtype)
+    if w > bound:
+        raise ValueError(
+            f"vote_dtype={vote_dtype!r} is integer-exact only up to world "
+            f"size {bound} (comm.vote_exact_max_world: 2^(mantissa+1)); "
+            f"this axis has {w} — use vote_dtype='float32'.")
     with trace_stage(STAGE_DECOMPRESS):
         dec = compressor.decompress(payload, ctx)
     with trace_stage(f"{STAGE_EXCHANGE}/psum_vote"):

@@ -21,6 +21,18 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def pack_widths():
+    """The declared (bits-per-code, pack, unpack) contract of this module:
+    every packer here must round-trip codes up to ``2**bits - 1`` and emit
+    exactly ``ceil(n*bits/8)`` bytes. The static auditor's numeric-safety
+    pass (:mod:`grace_tpu.analysis.flow`) verifies the declaration against
+    the live functions whenever an audited codec ships a sub-byte packed
+    payload — a widened code or a narrowed pack is a lint error, not a
+    silently corrupted wire word. A function so a new packer added here is
+    automatically under audit the moment it joins the tuple."""
+    return ((1, pack_bits, unpack_bits), (2, pack_2bit, unpack_2bit))
+
+
 def pack_bits(bits: jax.Array) -> jax.Array:
     """Pack a 1-D boolean/0-1 array into uint8, 8 values per byte (LSB first)."""
     n = bits.shape[0]

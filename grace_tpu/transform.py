@@ -221,12 +221,18 @@ def _bucketize(shapes_dtypes, bucket_bytes: Optional[int]):
     """Group leaf indices into fusion buckets of at most ``bucket_bytes``
     (whole leaves only; an oversized leaf gets its own bucket). ``None``
     means one bucket for everything. Deterministic in leaf order, so init
-    and update always agree. Returns (buckets, common_dtype)."""
+    and update always agree — and bucket count/ordering is a pinned
+    contract (tests/test_fusion.py): the static auditor's schedulability
+    pass derives the promised number of independent compress→exchange
+    chains from this exact plan. Concatenating the buckets always yields
+    ``range(n)``; an empty leaf list yields NO buckets (not one empty
+    bucket — an empty bucket would make the fused update concatenate
+    nothing). Returns (buckets, common_dtype)."""
     n = len(shapes_dtypes)
     cdtype = jnp.result_type(*(d for _, d in shapes_dtypes)) \
         if shapes_dtypes else jnp.float32
     if bucket_bytes is None:
-        return [list(range(n))], cdtype
+        return ([list(range(n))] if n else []), cdtype
     itemsize = jnp.dtype(cdtype).itemsize
     buckets, cur, cur_bytes = [], [], 0
     for i, (shape, _) in enumerate(shapes_dtypes):

@@ -40,6 +40,47 @@ class TestBucketize:
         _, dt = _bucketize([((4,), jnp.bfloat16), ((4,), jnp.float32)], None)
         assert dt == jnp.float32
 
+    # The plan below is a PINNED contract: the static auditor's
+    # schedulability pass (grace_tpu.analysis.flow) derives the promised
+    # number of independent compress→exchange chains from this exact
+    # bucket count and ordering, so a plan change is an API change.
+
+    def test_empty_leaf_list_yields_no_buckets(self):
+        """No leaves → no buckets, in BOTH modes (one empty bucket would
+        make the fused update concatenate nothing), dtype defaults f32."""
+        for bucket_bytes in (None, 512):
+            buckets, dt = _bucketize([], bucket_bytes)
+            assert buckets == []
+            assert dt == jnp.float32
+
+    def test_single_leaf_larger_than_bucket_is_one_bucket(self):
+        """One leaf over the limit: exactly one bucket holding it — never
+        split (whole leaves only), never dropped."""
+        buckets, _ = _bucketize([((1000,), jnp.float32)], 64)
+        assert buckets == [[0]]
+
+    def test_oversized_leaf_keeps_count_and_ordering(self):
+        """Oversized leaf in front: it closes its own bucket and the rest
+        re-pack after it — bucket count and leaf ordering are pinned."""
+        specs = [((1000,), jnp.float32)] + [((10,), jnp.float32)] * 3
+        buckets, _ = _bucketize(specs, 100)          # 40 B each after [0]
+        assert buckets == [[0], [1, 2], [3]]         # greedy: 80+40 > 100
+        # concatenating the buckets is always the identity permutation
+        assert [i for b in buckets for i in b] == list(range(len(specs)))
+
+    def test_mixed_dtype_bucket_promotion_prices_at_common_dtype(self):
+        """A bf16+f32 mix promotes to f32 and the byte accounting uses the
+        PROMOTED itemsize: 100 bf16 elements cost 400 B in the bucket, so
+        two of them no longer fit an 800 B bucket alongside an f32 leaf."""
+        specs = [((100,), jnp.bfloat16), ((100,), jnp.bfloat16),
+                 ((100,), jnp.float32)]
+        buckets, dt = _bucketize(specs, 800)
+        assert dt == jnp.float32
+        assert buckets == [[0, 1], [2]]              # 400+400, then 400
+        # at bf16's native itemsize all three would have fit — pin that
+        # the plan does NOT do that
+        assert buckets != [[0, 1, 2]]
+
 
 def _make_problem(rng, n=64):
     x = rng.standard_normal((n * 8, 12)).astype(np.float32)

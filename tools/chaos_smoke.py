@@ -289,8 +289,13 @@ def main(argv=None) -> int:
         lint_findings = run_repo_rules() + audit_config(
             {"name": "chaos_smoke-config",
              "params": grace_params,
+             # Everything except wire reconciliation (the escape cond makes
+             # the wire cost bimodal, same exclusion as the registry's
+             # escape entries) — the graft-flow passes (schedulability,
+             # numeric safety, footprint) gate this run's config too.
              "passes": ("collective_consistency", "bit_exactness",
-                        "signature_stability")})
+                        "signature_stability", "overlap_schedulability",
+                        "numeric_safety", "memory_footprint")})
         if sink is not None and lint_findings:
             emit_to_sink(lint_findings, sink)
         errors = [f for f in lint_findings if f.severity == "error"]

@@ -240,11 +240,22 @@ def build() -> str:
     lint = _load("LINT_LAST.json")
     if isinstance(lint, dict) and "errors" in lint:
         when = (lint.get("captured_at") or "").split("T")[0]
+        counts = lint.get("pass_counts") or {}
+        if counts:
+            dirty = {p: n for p, n in counts.items() if n}
+            per_pass = (f"; per-pass findings: "
+                        + ", ".join(f"{p} {n}"
+                                    for p, n in sorted(dirty.items()))
+                        if dirty else
+                        f"; all {len(counts)} passes clean")
+        else:
+            per_pass = ""
         parts.append(
             f"Static analysis: `graft_lint --all-configs` → "
             f"{lint['errors']} error(s) / {lint.get('warnings', 0)} "
             f"warning(s) over {lint.get('configs_audited', '?')} configs + "
-            f"{lint.get('rules_checked', '?')} repo rules "
+            f"{lint.get('rules_checked', '?')} repo rules"
+            f"{per_pass} "
             f"(`LINT_LAST.json`{', ' + when if when else ''}).")
     prof = _load("PROF_LAST.json")
     if isinstance(prof, dict) and prof.get("stages_ms"):

@@ -2,13 +2,18 @@
 """graft-lint CLI: static SPMD collective auditor + repo rule engine.
 
 Traces registered codec x communicator x resilience configs to jaxprs on an
-AbstractMesh (no devices, CPU-only, CI-safe) and runs the four audit passes
-(collective consistency across cond branches, bit-exactness of cross-replica
-reductions, wire-byte reconciliation against Communicator.recv_wire_bytes,
-retrace/host-sync sniffing), plus the AST-level repo rules (compressor
-capability declarations, telemetry FIELDS reducers, pytest marker
-registration). See grace_tpu/analysis/ and IMPLEMENTING.md "What graft-lint
-checks and why".
+AbstractMesh (no devices, CPU-only, CI-safe) and runs the seven audit
+passes — the four jaxpr walkers (collective consistency across cond
+branches, bit-exactness of cross-replica reductions, wire-byte
+reconciliation against Communicator.recv_wire_bytes, retrace/host-sync
+sniffing) plus the three graft-flow dependence-graph passes (overlap
+schedulability: static overlap bounds and independent compress→exchange
+chain counting; numeric-range safety: fp16 accumulation overflow, vote
+integer-exactness, index/pack-width contracts; HBM footprint: GraceState
+accounting vs the config's own eval_shape model, replicated-O(W) buffers)
+— plus the AST-level repo rules (compressor capability declarations,
+telemetry FIELDS reducers, pytest marker registration). See
+grace_tpu/analysis/ and IMPLEMENTING.md "What graft-lint checks and why".
 
 Exit status: 0 clean, 1 findings, 2 crash — CI-gateable.
 
@@ -17,6 +22,7 @@ Usage::
     python tools/graft_lint.py                   # repo rules + core configs
     python tools/graft_lint.py --all-configs     # the full compat matrix
     python tools/graft_lint.py --config topk-ring --config qsgd-ring
+    python tools/graft_lint.py --all-configs --passes numeric_safety
     python tools/graft_lint.py --all-configs --json
     python tools/graft_lint.py --all-configs --jsonl lint_findings.jsonl
     python tools/graft_lint.py --list            # show registry names
@@ -35,6 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # pre-commit hook; --all-configs is the CI spelling.
 CORE_CONFIGS = ("topk-allgather", "none-allreduce", "qsgd-ring",
                 "topk-twoshot", "signsgd-sign_allreduce",
+                "topk-allgather-bucketed",
                 "topk-escape-telemetry", "topk-guard-consensus")
 
 
@@ -49,6 +56,13 @@ def main(argv=None) -> int:
                     help="run only the AST repo rules (no tracing)")
     ap.add_argument("--no-rules", action="store_true",
                     help="skip the AST repo rules")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass subset (intersected with "
+                         "each config's own pass selection; configs with "
+                         "an empty intersection are skipped)")
+    ap.add_argument("--evidence", default=None,
+                    help="where --all-configs writes its LINT_LAST.json "
+                         "evidence (default: the repo root copy)")
     ap.add_argument("--world", type=int, default=8,
                     help="abstract mesh size to trace at (default 8)")
     ap.add_argument("--json", action="store_true",
@@ -71,9 +85,9 @@ def main(argv=None) -> int:
         except RuntimeError:
             pass
 
-    from grace_tpu.analysis import (AUDIT_CONFIGS, audit_all, render_text,
-                                    findings_to_json, run_repo_rules,
-                                    write_jsonl, RULE_NAMES)
+    from grace_tpu.analysis import (AUDIT_CONFIGS, PASS_NAMES, audit_all,
+                                    render_text, findings_to_json,
+                                    run_repo_rules, write_jsonl, RULE_NAMES)
 
     if args.list:
         for entry in AUDIT_CONFIGS:
@@ -93,6 +107,18 @@ def main(argv=None) -> int:
         configs = list(AUDIT_CONFIGS)
     else:
         configs = [e for e in AUDIT_CONFIGS if e["name"] in CORE_CONFIGS]
+    if args.passes:
+        selected = tuple(p.strip() for p in args.passes.split(",")
+                         if p.strip())
+        unknown = [p for p in selected if p not in PASS_NAMES]
+        if unknown:
+            print(f"unknown pass(es) {unknown}; registered: "
+                  f"{', '.join(PASS_NAMES)}", file=sys.stderr)
+            return 2
+        configs = [dict(e, passes=tuple(p for p in e["passes"]
+                                        if p in selected))
+                   for e in configs]
+        configs = [e for e in configs if e["passes"]]
     if args.rules_only:
         configs = []
 
@@ -115,6 +141,13 @@ def main(argv=None) -> int:
         import datetime
         import json as _json
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # Per-pass finding counts over every pass that could have run —
+        # zeros are evidence too (a pass that ran clean is a different
+        # statement than a pass that never ran); consumed by
+        # tools/evidence_summary.py.
+        passes_run = sorted({p for e in configs for p in e["passes"]})
+        pass_counts = {p: sum(1 for f in findings if f.pass_name == p)
+                       for p in passes_run}
         doc = {
             "tool": "graft_lint",
             "errors": sum(1 for f in findings if f.severity == "error"),
@@ -122,11 +155,13 @@ def main(argv=None) -> int:
             "configs_audited": len(configs),
             "rules_checked": rules_checked,
             "world": args.world,
+            "passes_run": passes_run,
+            "pass_counts": pass_counts,
             "findings": [f.as_dict() for f in findings],
             "captured_at": datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds"),
         }
-        path = os.path.join(root, "LINT_LAST.json")
+        path = args.evidence or os.path.join(root, "LINT_LAST.json")
         tmp = path + ".tmp"
         try:
             with open(tmp, "w") as f:

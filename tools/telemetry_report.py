@@ -180,7 +180,9 @@ def render(provenance, records, events,
     perf = [e for e in events if str(e.get("event", "")).startswith("perf_")]
     watch = [e for e in events
              if e.get("event") in ("watch", "watch_anomaly")]
-    other = [e for e in events if e not in perf and e not in watch]
+    lint = [e for e in events if e.get("event") == "lint_finding"]
+    other = [e for e in events
+             if e not in perf and e not in watch and e not in lint]
     if watch:
         out.append("")
         out.append("== watch (graft-watch summaries + anomalies) ==")
@@ -189,6 +191,11 @@ def render(provenance, records, events,
         out.append("")
         out.append("== profiling (ProfileRecorder perf_* records) ==")
         out.extend(_render_perf(perf))
+    if lint:
+        out.append("")
+        out.append(f"== static analysis ({len(lint)} lint_finding "
+                   "event(s)) ==")
+        out.extend(_render_lint(lint))
 
     out.append("")
     out.append(f"== guard events ({len(other)}) ==")
@@ -241,6 +248,24 @@ def _render_watch(watch: List[dict]) -> List[str]:
                 f"value {a.get('value', 0):.4g}")
     else:
         out.append("  anomalies: none")
+    return out
+
+
+def _render_lint(lint: List[dict]) -> List[str]:
+    """graft-lint ``lint_finding`` events (the chaos_smoke --lint gate and
+    ``graft_lint --jsonl``), one line per finding with the same stage
+    attribution the passes computed — so a schedulability/numeric/footprint
+    finding lands in the unified run timeline next to the guard/consensus
+    events of the step range it would have bitten."""
+    out = []
+    for e in lint:
+        loc = str(e.get("config", "?"))
+        if e.get("stage"):
+            loc += f" [{e['stage']}]"
+        out.append(f"  {str(e.get('severity', '?')).upper():7s} "
+                   f"{str(e.get('pass', '?')):24s} {loc}")
+        msg = str(e.get("message", ""))
+        out.append(f"          {msg[:160]}" + ("…" if len(msg) > 160 else ""))
     return out
 
 
@@ -331,8 +356,11 @@ def build_doc(provenance, records, events,
                             if e.get("event") == "watch_anomaly"],
         "perf_events": [e for e in events
                         if str(e.get("event", "")).startswith("perf_")],
+        "lint_findings": [e for e in events
+                          if e.get("event") == "lint_finding"],
         "guard_events": [e for e in events
-                         if e.get("event") not in ("watch", "watch_anomaly")
+                         if e.get("event") not in ("watch", "watch_anomaly",
+                                                   "lint_finding")
                          and not str(e.get("event", "")).startswith("perf_")],
     }
     return doc
