@@ -603,6 +603,12 @@ def bench_configs(platform: str, configs, emit) -> None:
             # Resolved (not configured) kernel engagement — the resume
             # gate compares this across semantic default changes.
             row_extra["pallas_enabled"] = resolved
+        # The RESOLVED fusion mode as a first-class row key (None | 'flat'
+        # | 'grouped' | int bucket bytes), not just a field buried in
+        # grace_params: a bucketed-executor capture and the flat-fusion
+        # headline must be distinguishable row-by-row, the same honesty
+        # contract as pallas_enabled.
+        row_extra["fusion"] = ent.grace.fusion
         if cfg.get("note"):
             # Config-level caveat (e.g. "bf16 grads use the staged Top-K
             # path") — evidence rows must carry their own context.
@@ -804,8 +810,15 @@ def _write_evidence(rows: list, path: str, metric: str, n_expected: int,
         # attributable to a revision. Best-effort: evidence persistence
         # must survive a broken git checkout.
         from grace_tpu.utils.logging import run_provenance
-        provenance = run_provenance(data="synthetic",
-                                    tool="bench", argv=" ".join(sys.argv[1:]))
+        # The headline row's resolved kernel/fusion modes ride the
+        # document-level provenance too: an evidence file whose headline
+        # was measured with pallas off or a different executor is
+        # distinguishable from one capture-level field, without digging
+        # through rows.
+        provenance = run_provenance(
+            data="synthetic", tool="bench", argv=" ".join(sys.argv[1:]),
+            pallas_enabled=(comp.get("pallas_enabled") if comp else None),
+            fusion=(comp.get("fusion") if comp else None))
     except Exception as e:
         print(f"[bench] provenance unavailable: {e}",
               file=sys.stderr, flush=True)

@@ -65,7 +65,8 @@ from grace_tpu.analysis.flow import (DepGraph, DepNode, build_depgraph,
                                      pass_numeric_safety,
                                      pass_overlap_schedulability)
 from grace_tpu.analysis.configs import (AUDIT_CONFIGS, audit_all,
-                                        audit_config, build_grace)
+                                        audit_config, build_grace,
+                                        overlap_bound_report)
 from grace_tpu.analysis.rules import RULE_NAMES, run_repo_rules
 from grace_tpu.analysis.report import (findings_to_json, render_text,
                                        write_jsonl)
@@ -81,6 +82,7 @@ __all__ = [
     "pass_overlap_schedulability", "pass_numeric_safety",
     "pass_memory_footprint",
     "AUDIT_CONFIGS", "audit_all", "audit_config", "build_grace",
+    "overlap_bound_report",
     "RULE_NAMES", "run_repo_rules",
     "findings_to_json", "render_text", "write_jsonl",
 ]

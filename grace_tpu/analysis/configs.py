@@ -27,7 +27,8 @@ from typing import Any, Dict, List, Optional, Sequence
 from grace_tpu.analysis.passes import Finding, PASS_NAMES, run_passes
 from grace_tpu.analysis.trace import trace_train_step, trace_update
 
-__all__ = ["AUDIT_CONFIGS", "audit_all", "audit_config", "build_grace"]
+__all__ = ["AUDIT_CONFIGS", "audit_all", "audit_config", "build_grace",
+           "overlap_bound_report"]
 
 _ALL = tuple(PASS_NAMES)
 _NO_WIRE = tuple(p for p in PASS_NAMES if p != "wire_reconciliation")
@@ -161,13 +162,42 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
     # the default params into K=2 buckets (w is 1920 B — its own bucket;
     # b rides the second), so the overlap_schedulability pass verifies the
     # traced graph actually exposes 2 independent compress→exchange chains
-    # — the schedulability contract ROADMAP item 2's chunked bucket
-    # scheduling will be built against.
+    # — the schedulability contract the bucketed overlap executor
+    # (ISSUE 10) now delivers at runtime.
     _cfg("topk-allgather-bucketed", {"compressor": "topk",
                                      "compress_ratio": 0.3,
                                      "memory": "residual",
                                      "communicator": "allgather",
                                      "fusion": 1024}),
+    # -- fused compress-and-pack wire formats (ISSUE 10) --------------------
+    # qsgd at quantum_num<=7 ships 4-bit packed nibbles (2 codes/byte):
+    # the payload is a sub-byte uint8 array, so numeric_safety's pack-width
+    # contract re-verifies ops/packing.pack_4bit on every audit, and
+    # wire_reconciliation prices the halved payload against the traced
+    # all_gather — the staged path traced here is byte-identical in layout
+    # to the fused Pallas kernel (bit-identity pinned in
+    # tests/test_pallas_quant.py).
+    _cfg("qsgd4-allgather-packed", {"compressor": "qsgd", "quantum_num": 7,
+                                    "use_pallas": False, "memory": "none",
+                                    "communicator": "allgather"}),
+    # Bucketed executor × packed wire × hop-requant ring in one trace: two
+    # independent per-bucket ring schedules (14 ppermute hops + 2 gathers),
+    # each requantizing 4-bit packed partials — schedulability must count
+    # K=2 chains and the wire model must reconcile per-bucket.
+    _cfg("qsgd4-ring-packed-bucketed", {"compressor": "qsgd",
+                                        "quantum_num": 7,
+                                        "use_pallas": False,
+                                        "memory": "none",
+                                        "communicator": "ring",
+                                        "fusion": 1024}),
+    # The fused sign-bitpack Pallas kernel traced INSIDE the audited graph
+    # (use_pallas=True runs the interpret-mode kernel off-TPU — same
+    # pallas_call equation structure as on-chip): proves the kernels are
+    # auditable, not a blind spot — the packed payload still reconciles
+    # and the pack-width contract still runs.
+    _cfg("signsgd-pallas-packed", {"compressor": "signsgd",
+                                   "use_pallas": True, "memory": "none",
+                                   "communicator": "allgather"}),
     # -- graft-watch variants (ISSUE 8): the watch summary adds a lax.cond
     #    (window-boundary predicate from the replicated step counter) whose
     #    taken branch issues an all_gather the untaken branch lacks — the
@@ -218,6 +248,21 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
           "escape": "fp16", "consensus": True},
          passes=_NO_WIRE, mode="train",
          guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+    # The bucketed executor under the full resilience stack (ISSUE 10):
+    # the escape cond's compressed branch is now K=2 per-bucket pipelines
+    # (its dense branch stays per-leaf — branches differ by whole
+    # schedules, legal only because the fallback predicate is replicated),
+    # the guard's post-exchange check runs once over ALL buckets' updates
+    # and its rollback selects the whole per-bucket state tuple
+    # atomically, and the consensus audit fingerprints downstream of the
+    # split — collective_consistency and bit_exactness must bless all of
+    # it with the bucketed schedule in place.
+    _cfg("bucketed-guard-consensus",
+         {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+          "communicator": "allgather", "fusion": 1024, "escape": "fp16",
+          "telemetry": True, "consensus": True},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
     # The full observability+resilience stack in one trace: watch's gated
     # gather, the escape cond, the guard's psum-OR and the consensus audit
     # all nested in one train step — every replicated-predicate argument
@@ -235,6 +280,36 @@ def build_grace(entry: Dict[str, Any]):
     """The Grace bundle for one registry entry."""
     from grace_tpu.helper import grace_from_params
     return grace_from_params(dict(entry["params"]))
+
+
+def overlap_bound_report(entry: Dict[str, Any], *, world: int = 8
+                         ) -> Optional[Dict[str, Any]]:
+    """Schedulability evidence for one bucketed (``fusion=<int bytes>``)
+    update-mode registry entry: the static overlap upper bound, the counted
+    independent compress→exchange chains, and the bucketing plan's promised
+    K. ``None`` for entries the overlap sandwich doesn't apply to (non-int
+    fusion, or train mode — the fwd/bwd graph drowns the bound in model
+    compute). Written into ``LINT_LAST.json`` by ``tools/graft_lint.py
+    --all-configs`` so the measured side of the sandwich
+    (``tools/perf_report.py --overlap-config``) always has the static side
+    on record next to the lint verdict it came from."""
+    from grace_tpu.analysis import flow
+
+    fusion = entry["params"].get("fusion")
+    if entry.get("mode", "update") != "update" \
+            or isinstance(fusion, bool) or not isinstance(fusion, int):
+        return None
+    grace = entry.get("grace") or build_grace(entry)
+    traced = trace_update(grace, world=world, name=entry["name"],
+                          meta={"grace": grace})
+    s = flow.overlap_summary(traced)
+    bound = s["static_overlap_bound"]
+    return {"static_overlap_bound": (round(bound, 6)
+                                     if bound is not None else None),
+            "independent_chains": int(s["independent_chains"]),
+            "expected_chains": flow._expected_chains(traced),
+            "exchange_collectives": int(s["exchange_collectives"]),
+            "world": int(world)}
 
 
 def audit_config(entry: Dict[str, Any], *, world: int = 8

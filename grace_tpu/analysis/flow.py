@@ -111,6 +111,22 @@ def _raw_stack(eqn) -> str:
         return ""
 
 
+_BUCKET_RE = None
+
+
+def _bucket_of(eqn) -> Optional[str]:
+    """The ``grace/bucket/<b>`` scope id an equation was traced under, or
+    None — the bucketed executor's per-pipeline tag."""
+    global _BUCKET_RE
+    if _BUCKET_RE is None:
+        import re
+
+        from grace_tpu.telemetry.scopes import STAGE_BUCKET
+        _BUCKET_RE = re.compile(re.escape(STAGE_BUCKET) + r"/(\d+)")
+    m = _BUCKET_RE.search(_raw_stack(eqn))
+    return m.group(0) if m else None
+
+
 # ---------------------------------------------------------------------------
 # the dependence graph
 # ---------------------------------------------------------------------------
@@ -120,7 +136,11 @@ class DepNode:
     """One flattened equation. ``nbytes`` (total output bytes) is the cost
     proxy both overlap weighting and wire-buffer accounting use; ``roots``
     is a bitmask over the traced graph's gradient inputs this equation
-    transitively depends on."""
+    transitively depends on; ``chain`` is the bucketed executor's
+    ``grace/bucket/<b>`` scope id when the equation was traced inside one
+    (None elsewhere) — the per-pipeline tag chain counting groups by when
+    gradient roots alone cannot separate buckets (a train-step trace: every
+    bucket's gradient descends from the same batch inputs)."""
 
     idx: int
     prim: str
@@ -128,6 +148,7 @@ class DepNode:
     nbytes: int
     collective: bool
     roots: int = 0
+    chain: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -218,7 +239,8 @@ def build_depgraph(traced: TracedGraph) -> DepGraph:
                         and traced.axis_name in _axes_of(eqn))
                 nodes.append(DepNode(idx=idx, prim=name,
                                      stage=_stage_of(eqn), nbytes=nbytes,
-                                     collective=coll, roots=in_root))
+                                     collective=coll, roots=in_root,
+                                     chain=_bucket_of(eqn)))
                 anc.append(in_anc)
                 out = (in_anc | (1 << idx), in_root)
                 for ov in eqn.outvars:
@@ -243,11 +265,15 @@ def overlap_summary(traced: TracedGraph,
     per-collective bound ``min(1, independent_compute / collective_bytes)``
     aggregates (collective-byte weighted) into ``static_overlap_bound``,
     the static upper bound on graft-prof's measured overlap fraction.
-    ``independent_chains`` counts exchange-stage collectives with no other
-    exchange-stage collective as ancestor — the number of compress→exchange
-    chains the scheduler can actually interleave (a multi-phase schedule
-    like ring/two-shot is ONE chain: its phases share gradient roots and
-    chain by construction).
+    ``independent_chains`` counts *gradient-disjoint* chain heads:
+    exchange-stage collectives with no other exchange-stage collective as
+    ancestor, grouped by their gradient-root sets — a payload of several
+    wire tensors (top-k values + indices, packed codes + norm) is ONE
+    chain, not one per tensor, because its collectives all hang off the
+    same bucket's gradients; a multi-phase schedule like ring/two-shot is
+    likewise one chain (its phases share gradient roots and chain by
+    construction). The bucketed executor's K buckets partition the
+    gradient leaves, so its chains count exactly K.
     """
     g = graph if graph is not None else build_depgraph(traced)
     computes = [n for n in g.nodes if not n.collective and n.nbytes > 0]
@@ -271,9 +297,17 @@ def overlap_summary(traced: TracedGraph,
     heads = [c for c in ex
              if not any(g.is_ancestor(o.idx, c.idx)
                         for o in ex if o is not c)]
+    # Chain identity = (gradient-root set, bucket scope): the root set
+    # separates per-leaf/seeded chains, the grace/bucket/<b> tag separates
+    # the bucketed executor's pipelines when every bucket's gradient
+    # descends from the same inputs (train-step traces — the whole batch
+    # feeds the backward). A head with neither (constant-fed bookkeeping)
+    # counts as its own chain rather than collapsing unrelated heads.
+    chains = {((n.roots if n.roots else ("head", n.idx)), n.chain)
+              for n in heads}
     return {"n_collectives": len(colls),
             "exchange_collectives": len(ex),
-            "independent_chains": len(heads),
+            "independent_chains": len(chains),
             "total_compute_bytes": total_compute,
             "static_overlap_bound": bound,
             "per_collective": per}
@@ -486,28 +520,17 @@ def _multiplicity_walk(traced: TracedGraph):
 
 def _codec_payload_structs(traced: TracedGraph):
     """The (n_elems, struct) list the active fusion mode actually hands the
-    codec — mirrors :func:`grace_tpu.transform.fusion_payload_nbytes`'s
-    enumeration so the index-dtype check sees the fused leaf sizes, not the
+    codec — literally :func:`grace_tpu.transform.fusion_payload_structs`
+    (the enumeration the executor and the wire models share), so the
+    index-dtype and pack-width checks see the fused leaf sizes, not the
     raw per-parameter ones."""
-    import jax.numpy as jnp
-
-    from grace_tpu.transform import _bucketize, _group_views
+    from grace_tpu.transform import fusion_payload_structs
 
     grace = traced.meta.get("grace")
     structs = _param_structs(traced)
     fusion = getattr(grace, "fusion", None)
-    if fusion == "grouped":
-        reps = [structs[idxs[0]] for idxs in _group_views(structs)]
-    elif fusion is None:
-        reps = list(structs)
-    else:
-        bucket_bytes = None if fusion == "flat" else int(fusion)
-        buckets, cdtype = _bucketize([(s.shape, s.dtype) for s in structs],
-                                     bucket_bytes)
-        reps = [jax.ShapeDtypeStruct(
-            (sum(int(np.prod(structs[i].shape, dtype=np.int64))
-                 for i in idxs),), jnp.dtype(cdtype)) for idxs in buckets]
-    return [(int(np.prod(s.shape, dtype=np.int64)), s) for s in reps]
+    return [(int(np.prod(s.shape, dtype=np.int64)), s)
+            for s, _count in fusion_payload_structs(structs, fusion)]
 
 
 def _index_dtype_findings(traced: TracedGraph) -> List[Finding]:

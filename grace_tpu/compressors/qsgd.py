@@ -7,6 +7,16 @@ for larger level counts the reference casts to torch.half (qsgd.py:27),
 which silently loses integer precision above 2048 — here we use int16
 instead (exact, same wire width). The torch copy's leftover debug prints
 (torch/compressor/qsgd.py:14-15,33-34) are, of course, not replicated.
+
+Sub-byte wire format (grace-tpu extension, no reference analog): for
+``quantum_num <= 7`` the signed levels fit a 4-bit two's-complement
+nibble, so the payload ships packed 2 codes/byte — 2× less wire than int8
+— via :func:`grace_tpu.ops.packing.pack_4bit` (staged path) or the fused
+Pallas quantize-and-pack kernel
+(:func:`grace_tpu.ops.pallas_quant.quantize_pack_stochastic`), which
+emits the packed bytes directly from VMEM with no full-width intermediate
+in HBM. Both paths produce the identical byte layout (the pack_widths
+contract, bit-identity pinned in tests/test_pallas_quant.py).
 """
 
 from __future__ import annotations
@@ -17,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from grace_tpu.core import Compressor, Ctx, Payload, State
+from grace_tpu.ops.packing import pack_4bit, unpack_4bit
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +78,13 @@ class QSGDCompressor(Compressor):
             return True, not on_tpu
         return False, False
 
+    @property
+    def packed_wire(self) -> bool:
+        """True iff the payload ships 4-bit packed nibbles (2 codes/byte):
+        the sub-byte wire format engages when the level range (±quantum_num
+        after the overshoot clamp) fits a two's-complement nibble."""
+        return self.quantum_num <= 7
+
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
         shape = x.shape
@@ -75,8 +93,14 @@ class QSGDCompressor(Compressor):
         dtype = jnp.int8 if self.quantum_num < 128 else jnp.int16
         enabled, interpret = self._pallas_mode()
         if enabled:
-            from grace_tpu.ops.pallas_quant import quantize_stochastic
             seed = jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
+            if self.packed_wire:
+                from grace_tpu.ops.pallas_quant import \
+                    quantize_pack_stochastic
+                packed = quantize_pack_stochastic(
+                    flat, norm, seed, self.quantum_num, interpret=interpret)
+                return (packed, norm), (shape, x.dtype), state
+            from grace_tpu.ops.pallas_quant import quantize_stochastic
             signed = quantize_stochastic(flat, norm, seed, self.quantum_num,
                                          out_dtype=dtype,
                                          interpret=interpret)
@@ -88,10 +112,24 @@ class QSGDCompressor(Compressor):
         is_next = (prob < (level_float - previous_level)).astype(flat.dtype)
         new_level = previous_level + is_next
         signed = new_level * jnp.sign(flat)
+        if self.packed_wire:
+            # Same clamp + nibble fold as the fused kernel, then the
+            # reference packer — staged and kernel paths share ONE byte
+            # layout (they differ only in the PRNG stream).
+            q = float(self.quantum_num)
+            clamped = jnp.clip(signed.astype(jnp.float32), -q, q)
+            codes = jnp.where(clamped < 0, clamped + 16.0,
+                              clamped).astype(jnp.uint8)
+            return (pack_4bit(codes), norm), (shape, x.dtype), state
         return (signed.astype(dtype), norm), (shape, x.dtype), state
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
         levels, norm = payload
         shape, dtype = ctx
+        if self.packed_wire:
+            import numpy as np
+            numel = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            codes = unpack_4bit(levels, numel).astype(jnp.int8)
+            levels = jnp.where(codes >= 8, codes - 16, codes)
         out = norm / self.quantum_num * levels.astype(dtype)
         return out.reshape(shape)

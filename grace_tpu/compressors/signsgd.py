@@ -39,11 +39,46 @@ class SignSGDCompressor(Compressor):
     # precisely because the payload is not summable.
     summable_payload = False
 
+    # Fused Pallas sign-bitpack kernel (grace_tpu/ops/pallas_quant.sign_pack):
+    # the packed sign mask leaves VMEM wire-ready instead of staging a full
+    # bool tensor through the jnp shift/sum pack. Sign extraction is
+    # deterministic, so kernel and staged paths are BIT-IDENTICAL (pinned in
+    # tests/test_pallas_quant.py) — 'auto' (kernel on real TPU, staged
+    # elsewhere) can never change results, only where the bytes are packed.
+    # True forces the kernel even off-TPU (interpret mode: slow, test-only);
+    # False forces the staged jnp pack.
+    use_pallas: bool | str = "auto"
+
+    def __post_init__(self):
+        # Identity membership, not ==: 1 == True would pass equality
+        # validation yet dodge the `is True` checks below.
+        if not (self.use_pallas == "auto" or self.use_pallas is True
+                or self.use_pallas is False):
+            raise ValueError(f"use_pallas must be True, False or 'auto'; "
+                             f"got {self.use_pallas!r}")
+
+    def _pallas_mode(self):
+        import jax as _jax
+
+        from grace_tpu.ops import pallas_disabled
+        if pallas_disabled(explicit=self.use_pallas is True, kernel="quant"):
+            return False, False
+        if self.use_pallas == "auto":
+            return _jax.default_backend() == "tpu", False
+        if self.use_pallas is True:
+            return True, _jax.default_backend() != "tpu"
+        return False, False
+
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
         shape, numel = x.shape, x.size
         flat = x.reshape(-1)
-        packed = pack_bits(flat >= 0)
+        enabled, interpret = self._pallas_mode()
+        if enabled:
+            from grace_tpu.ops.pallas_quant import sign_pack
+            packed = sign_pack(flat, interpret=interpret)
+        else:
+            packed = pack_bits(flat >= 0)
         return (packed,), (numel, shape, x.dtype), state
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
@@ -87,6 +122,11 @@ class SignumCompressor(SignSGDCompressor):
         flat = x.reshape(-1)
         blended = (1.0 - self.momentum) * flat + self.momentum * state["momentum"]
         m = jnp.where(state["initialized"], blended, flat)
-        packed = pack_bits(m >= 0)
+        enabled, interpret = self._pallas_mode()
+        if enabled:
+            from grace_tpu.ops.pallas_quant import sign_pack
+            packed = sign_pack(m, interpret=interpret)
+        else:
+            packed = pack_bits(m >= 0)
         new_state = {"momentum": m, "initialized": jnp.ones((), jnp.bool_)}
         return (packed,), (numel, shape, x.dtype), new_state

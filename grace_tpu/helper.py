@@ -94,9 +94,12 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
     if name == "terngrad":
         return C.TernGradCompressor()
     if name == "signsgd":
-        return C.SignSGDCompressor()
+        return C.SignSGDCompressor(use_pallas=params.get("use_pallas",
+                                                         "auto"))
     if name == "signum":
-        return C.SignumCompressor(momentum=params.get("momentum", 0.9))
+        return C.SignumCompressor(momentum=params.get("momentum", 0.9),
+                                  use_pallas=params.get("use_pallas",
+                                                        "auto"))
     if name == "efsignsgd":
         return C.EFSignSGDCompressor(lr=params.get("lr", 0.1))
     if name == "onebit":

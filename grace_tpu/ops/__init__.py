@@ -1,12 +1,12 @@
 import os
 import warnings
 
-from grace_tpu.ops.packing import (pack_2bit, pack_bits, unpack_2bit,
-                                   unpack_bits)
+from grace_tpu.ops.packing import (pack_2bit, pack_4bit, pack_bits,
+                                   unpack_2bit, unpack_4bit, unpack_bits)
 from grace_tpu.ops.sparse import scatter_dense
 
 __all__ = ["pack_bits", "unpack_bits", "pack_2bit", "unpack_2bit",
-           "scatter_dense", "pallas_disabled"]
+           "pack_4bit", "unpack_4bit", "scatter_dense", "pallas_disabled"]
 
 
 def _env_true(name: str) -> bool:

@@ -29,8 +29,17 @@ def pack_widths():
     the live functions whenever an audited codec ships a sub-byte packed
     payload — a widened code or a narrowed pack is a lint error, not a
     silently corrupted wire word. A function so a new packer added here is
-    automatically under audit the moment it joins the tuple."""
-    return ((1, pack_bits, unpack_bits), (2, pack_2bit, unpack_2bit))
+    automatically under audit the moment it joins the tuple.
+
+    The 1-bit entry is signsgd/signum's sign mask, the 2-bit entry
+    terngrad-style codes, the 4-bit entry QSGD's sub-byte wire format
+    (``quantum_num <= 7``: two's-complement nibbles, low nibble first) —
+    the widths the fused Pallas compress-and-pack kernels
+    (:mod:`grace_tpu.ops.pallas_quant`) emit directly, so the kernels'
+    wire layout is pinned to these reference packers by the bit-identity
+    tests AND re-audited here on every lint run."""
+    return ((1, pack_bits, unpack_bits), (2, pack_2bit, unpack_2bit),
+            (4, pack_4bit, unpack_4bit))
 
 
 def pack_bits(bits: jax.Array) -> jax.Array:
@@ -65,4 +74,24 @@ def unpack_2bit(packed: jax.Array, n: int) -> jax.Array:
     """Inverse of :func:`pack_2bit`; returns uint8 codes of length ``n``."""
     shifts = jnp.arange(0, 8, 2, dtype=jnp.uint8)
     codes = (packed[:, None] >> shifts) & jnp.uint8(3)
+    return codes.reshape(-1)[:n]
+
+
+def pack_4bit(codes: jax.Array) -> jax.Array:
+    """Pack a 1-D array of 4-bit codes (values 0..15) into uint8, 2 per
+    byte (low nibble first — the layout the fused Pallas quantize-and-pack
+    kernel emits)."""
+    n = codes.shape[0]
+    nbytes = _ceil_div(n, 2)
+    padded = jnp.zeros((nbytes * 2,), jnp.uint8).at[:n].set(
+        codes.astype(jnp.uint8))
+    lanes = padded.reshape(nbytes, 2)
+    shifts = jnp.arange(0, 8, 4, dtype=jnp.uint8)
+    return jnp.sum(lanes << shifts, axis=1, dtype=jnp.uint8)
+
+
+def unpack_4bit(packed: jax.Array, n: int) -> jax.Array:
+    """Inverse of :func:`pack_4bit`; returns uint8 codes of length ``n``."""
+    shifts = jnp.arange(0, 8, 4, dtype=jnp.uint8)
+    codes = (packed[:, None] >> shifts) & jnp.uint8(15)
     return codes.reshape(-1)[:n]

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: fused stochastic quantization.
+"""Pallas TPU kernels: fused stochastic quantization and compress-and-pack.
 
 The QSGD family (reference grace_dl/dist/compressor/qsgd.py:19-23) needs a
 uniform random draw per element for stochastic rounding. Expressed in plain
@@ -13,6 +13,22 @@ garbage that callers slice off.
 
 Used by ``QSGDCompressor(use_pallas=True)``; runs in interpreter mode on
 CPU so the test suite exercises the same code path everywhere.
+
+**Fused compress-and-pack** (the EQuARX regime — quantize/pack fused into
+the kernel that produces the wire payload, arXiv:2506.17615):
+:func:`quantize_pack_stochastic` and :func:`sign_pack` emit the packed
+sub-byte wire words *directly* — the payload leaves VMEM wire-ready
+(ceil(n·bits/8) uint8 bytes) instead of staging full-width codes through
+HBM for a separate jnp packing pass. The byte layout is pinned to the
+reference packers' :func:`grace_tpu.ops.packing.pack_widths` contracts
+(LSB-first within a byte, low nibble first), verified bit-exactly by
+tests/test_pallas_quant.py, and re-audited by the static analyzer's
+numeric-safety pass whenever a codec ships a packed payload. Packing is
+expressed as a small matmul against a constant 0/1·2^k matrix — groups of
+``8/bits`` consecutive lanes reduce onto one output byte lane on the MXU
+(all values ≤ 255, exact in f32 accumulation), which keeps the lane-
+dimension reduction a single dot instead of a Mosaic-hostile strided
+gather.
 """
 
 from __future__ import annotations
@@ -58,25 +74,34 @@ def _hash_bits(seed, shape):
     return h ^ (h >> 16)
 
 
+def _signed_levels(x, scale, block_seed, hw_prng: bool):
+    """The QSGD stochastic-rounding core, shared VERBATIM by the plain
+    quantize kernel and the fused quantize-and-pack kernel — bit-identity
+    between 'quantize then pack' and 'fused compress-and-pack' holds
+    because both run literally this expression over the same block/seed
+    layout."""
+    level_float = jnp.abs(x) * scale
+    previous = jnp.floor(level_float)
+    if hw_prng:
+        pltpu.prng_seed(block_seed)
+        bits = pltpu.prng_random_bits(x.shape).astype(jnp.uint32)
+    else:
+        bits = _hash_bits(block_seed, x.shape)
+    # Top 24 bits -> uniform [0, 1) with full f32 mantissa coverage.
+    # Mosaic has no uint32->f32 cast (observed on-chip: NotImplementedError
+    # "Unsupported cast: uint32 -> float32"); bits>>8 < 2^24 fits int32
+    # exactly, so the int32 hop is lossless.
+    u = ((bits >> 8).astype(jnp.int32).astype(jnp.float32)
+         * (1.0 / (1 << 24)))
+    level = previous + (u < level_float - previous).astype(jnp.float32)
+    return level * jnp.sign(x)
+
+
 def _make_quantize_kernel(hw_prng: bool):
     def kernel(seed_ref, scale_ref, x_ref, out_ref):
         block_seed = seed_ref[0] + pl.program_id(0)
-        x = x_ref[:]
-        level_float = jnp.abs(x) * scale_ref[0]
-        previous = jnp.floor(level_float)
-        if hw_prng:
-            pltpu.prng_seed(block_seed)
-            bits = pltpu.prng_random_bits(x.shape).astype(jnp.uint32)
-        else:
-            bits = _hash_bits(block_seed, x.shape)
-        # Top 24 bits -> uniform [0, 1) with full f32 mantissa coverage.
-        # Mosaic has no uint32->f32 cast (observed on-chip: NotImplementedError
-        # "Unsupported cast: uint32 -> float32"); bits>>8 < 2^24 fits int32
-        # exactly, so the int32 hop is lossless.
-        u = ((bits >> 8).astype(jnp.int32).astype(jnp.float32)
-             * (1.0 / (1 << 24)))
-        level = previous + (u < level_float - previous).astype(jnp.float32)
-        out_ref[:] = (level * jnp.sign(x)).astype(out_ref.dtype)
+        signed = _signed_levels(x_ref[:], scale_ref[0], block_seed, hw_prng)
+        out_ref[:] = signed.astype(out_ref.dtype)
 
     return kernel
 
@@ -115,3 +140,155 @@ def quantize_stochastic(flat: jax.Array, norm: jax.Array, seed: jax.Array,
         interpret=_interpret_mode(interpret),
     )(seed.reshape(1).astype(jnp.int32), scale.reshape(1), x2d)
     return out.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# fused compress-and-pack
+# ---------------------------------------------------------------------------
+
+# Sign-pack block: 1024 input lanes reduce 8:1 onto 128 output byte lanes
+# (a full lane tile for the uint8 output); 32 sublanes hit the uint8
+# (32, 128) minimum output tile exactly.
+SIGN_ROWS = 32
+SIGN_LANES = 1024
+
+
+@functools.lru_cache(maxsize=8)
+def _pack_matrix_np(width: int, in_lanes: int):
+    import numpy as np
+
+    per_byte = 8 // width
+    w = np.zeros((in_lanes, in_lanes // per_byte), np.float32)
+    for lane in range(in_lanes):
+        w[lane, lane // per_byte] = float(1 << (width * (lane % per_byte)))
+    return w
+
+
+def _pack_matrix(width: int, in_lanes: int) -> jax.Array:
+    """The constant pack matrix: ``W[l, l // (8//width)] = 2^(width·(l mod
+    8//width))``, zero elsewhere. ``codes @ W`` sums each group of
+    ``8/width`` consecutive lanes' codes shifted into their byte position —
+    exactly :mod:`grace_tpu.ops.packing`'s LSB-first layout, as one MXU dot
+    (every product ≤ 240 and every byte sum ≤ 255: exact in f32). The
+    numpy constant is cached; the device constant is minted per trace (a
+    cached jnp array would leak a tracer across jits)."""
+    return jnp.asarray(_pack_matrix_np(width, in_lanes))
+
+
+def _pack_lanes(codes, packw_ref):
+    """Pack f32 integer codes (rows, L) -> (rows, L·width/8) uint8 via the
+    pack-matrix dot. int32 hop on the way out: Mosaic's f32->uint8 path is
+    the same cast class the PRNG bits needed in reverse."""
+    packed = jax.lax.dot_general(codes, packw_ref[:],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    return packed.astype(jnp.int32).astype(jnp.uint8)
+
+
+def _make_quantize_pack_kernel(hw_prng: bool):
+    def kernel(seed_ref, scale_ref, q_ref, packw_ref, x_ref, out_ref):
+        block_seed = seed_ref[0] + pl.program_id(0)
+        signed = _signed_levels(x_ref[:], scale_ref[0], block_seed, hw_prng)
+        # Two's-complement nibble: clamp to ±quantum_num (stochastic
+        # overshoot past +q would not fit the nibble's +7 ceiling at q=7),
+        # then fold negatives into [8, 15]. Low nibble = first element —
+        # packing.pack_4bit's layout.
+        q = q_ref[0].astype(jnp.float32)
+        signed = jnp.clip(signed, -q, q)
+        codes = signed + 16.0 * (signed < 0).astype(jnp.float32)
+        out_ref[:] = _pack_lanes(codes, packw_ref)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("quantum_num", "interpret"))
+def quantize_pack_stochastic(flat: jax.Array, norm: jax.Array,
+                             seed: jax.Array, quantum_num: int,
+                             interpret: bool = False) -> jax.Array:
+    """Fused QSGD compress-and-pack: stochastically quantize ``flat`` (1-D
+    f32) to signed levels in ``[-quantum_num, quantum_num]`` and emit the
+    packed 4-bit two's-complement wire words in one kernel — the payload
+    leaves VMEM wire-ready (``ceil(n/2)`` uint8 bytes).
+
+    Requires ``quantum_num <= 7`` (the 4-bit nibble's magnitude ceiling).
+    Bit-identity contract (pinned in tests/test_pallas_quant.py): equals
+    :func:`quantize_stochastic` at the same seed followed by clamp →
+    nibble-fold → :func:`grace_tpu.ops.packing.pack_4bit` — same block
+    layout, same PRNG stream, same rounding expression, so fusing the pack
+    changes WHERE the bytes are produced, never WHAT they are.
+    """
+    if quantum_num > 7:
+        raise ValueError(
+            f"quantize_pack_stochastic packs 4-bit two's-complement levels "
+            f"(magnitude <= 7); quantum_num={quantum_num} cannot fit — use "
+            "quantize_stochastic (int8/int16 wire) instead.")
+    n = flat.size
+    block = ROWS_PER_BLOCK * LANES
+    n_pad = -n % block
+    # Zero padding quantizes to level 0 -> code 0, matching pack_4bit's
+    # zero-padded final byte, so a shared trailing byte is still identical.
+    padded = jnp.pad(flat.astype(jnp.float32), (0, n_pad))
+    rows = padded.size // LANES
+    x2d = padded.reshape(rows, LANES)
+    scale = jnp.where(norm > 0, quantum_num / norm, 0.0).astype(jnp.float32)
+
+    out = pl.pallas_call(
+        _make_quantize_pack_kernel(hw_prng=not interpret),
+        grid=(rows // ROWS_PER_BLOCK,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((LANES, LANES // 2), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROWS_PER_BLOCK, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROWS_PER_BLOCK, LANES // 2), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES // 2), jnp.uint8),
+        interpret=_interpret_mode(interpret),
+    )(seed.reshape(1).astype(jnp.int32), scale.reshape(1),
+      jnp.asarray(quantum_num, jnp.int32).reshape(1),
+      _pack_matrix(4, LANES), x2d)
+    return out.reshape(-1)[: -(-n // 2)]
+
+
+def _sign_pack_kernel(packw_ref, x_ref, out_ref):
+    bits = (x_ref[:] >= 0).astype(jnp.float32)
+    out_ref[:] = _pack_lanes(bits, packw_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def sign_pack(flat: jax.Array, interpret: bool = False) -> jax.Array:
+    """Fused signSGD compress-and-pack: the sign mask of ``flat`` (1-D, any
+    float dtype) packed 8 signs/byte in one kernel — bit-identical to
+    ``packing.pack_bits(flat >= 0)`` (pinned in tests), deterministic, so
+    kernel and staged paths agree everywhere, not just in distribution.
+    """
+    n = flat.size
+    block = SIGN_ROWS * SIGN_LANES
+    n_pad = -n % block
+    # Pad with -1.0: a negative pad lane contributes a 0 bit, exactly like
+    # pack_bits' zero padding, so a shared final byte is still identical.
+    # (float32 cast preserves sign for every input dtype incl. -0.0, whose
+    # >= 0 is True on both paths.)
+    padded = jnp.pad(flat.astype(jnp.float32), (0, n_pad),
+                     constant_values=-1.0)
+    rows = padded.size // SIGN_LANES
+    x2d = padded.reshape(rows, SIGN_LANES)
+    out = pl.pallas_call(
+        _sign_pack_kernel,
+        grid=(rows // SIGN_ROWS,),
+        in_specs=[
+            pl.BlockSpec((SIGN_LANES, SIGN_LANES // 8), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((SIGN_ROWS, SIGN_LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((SIGN_ROWS, SIGN_LANES // 8),
+                               lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, SIGN_LANES // 8), jnp.uint8),
+        interpret=_interpret_mode(interpret),
+    )(_pack_matrix(1, SIGN_LANES), x2d)
+    return out.reshape(-1)[: -(-n // 8)]

@@ -29,7 +29,7 @@ __all__ = ["trace_stage", "match_stage", "ALL_STAGES",
            "STAGE_EXCHANGE", "STAGE_DECOMPRESS", "STAGE_MEMORY_UPDATE",
            "STAGE_FWD_BWD", "STAGE_OPTIMIZER", "STAGE_APPLY",
            "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS",
-           "STAGE_RING_HOP", "STAGE_WATCH"]
+           "STAGE_RING_HOP", "STAGE_WATCH", "STAGE_BUCKET"]
 
 # Canonical stage names — one vocabulary for the profiler, the report tool,
 # and the docs. Keep in sync with README "Observability".
@@ -53,6 +53,14 @@ STAGE_RING_HOP = "grace/ring_hop"
 # math — one attributable span so its (tiny) cost never hides inside the
 # telemetry scope it runs next to.
 STAGE_WATCH = "grace/watch"
+# Bucketed overlap executor (transform.py, fusion=<int bytes>): each
+# bucket's full compensate→compress→exchange→decompress→memory-update
+# chain renders as its own "grace/bucket/<b>" span, so a device trace
+# shows bucket i's exchange overlapping bucket i+1's compression — the
+# per-chain attribution the measured-vs-static overlap sandwich reads.
+# The inner pipeline scopes nest inside it; match_stage's rightmost rule
+# still attributes their ops to compress/exchange/… as before.
+STAGE_BUCKET = "grace/bucket"
 
 # The canonical stage vocabulary, longest-prefix-matchable: the profiler,
 # tools/telemetry_report.py, and the static auditor's finding attribution
@@ -64,7 +72,7 @@ ALL_STAGES = tuple(sorted(
     (STAGE_COMPENSATE, STAGE_COMPRESS, STAGE_EXCHANGE, STAGE_DECOMPRESS,
      STAGE_MEMORY_UPDATE, STAGE_FWD_BWD, STAGE_OPTIMIZER, STAGE_APPLY,
      STAGE_TELEMETRY, STAGE_DENSE_ESCAPE, STAGE_CONSENSUS, STAGE_RING_HOP,
-     STAGE_WATCH),
+     STAGE_WATCH, STAGE_BUCKET),
     key=len, reverse=True))
 
 

@@ -55,13 +55,13 @@ import numpy as np
 import optax
 from jax import lax
 
-from grace_tpu.core import (Communicator, Compressor, Memory, State,
-                            Topology, axis_size)
+from grace_tpu.core import (Communicator, Compressor, LinkBytes, Memory,
+                            State, Topology, axis_size)
 from grace_tpu.telemetry.aggregate import (normalize_watch,
                                            watch_gather_bytes, watch_init,
                                            watch_record)
-from grace_tpu.telemetry.scopes import (STAGE_TELEMETRY, STAGE_WATCH,
-                                        trace_stage)
+from grace_tpu.telemetry.scopes import (STAGE_BUCKET, STAGE_TELEMETRY,
+                                        STAGE_WATCH, trace_stage)
 from grace_tpu.telemetry.state import (TelemetryConfig, telemetry_init,
                                        telemetry_record)
 
@@ -258,6 +258,33 @@ def _group_views(leaves):
     return list(groups.values())
 
 
+def fusion_payload_structs(leaves, fusion) -> list:
+    """``[(struct, multiplicity), ...]`` — the exact tensor structures the
+    active fusion mode hands the codec, one entry per distinct compress
+    call shape. Per-leaf: every leaf, ×1. ``'grouped'``: one representative
+    per shape group, ×group size (vmap batches identical compressions).
+    ``'flat'``/int buckets: one flat common-dtype buffer per bucket, ×1 —
+    for int buckets this is also the executor's chain plan: one entry ==
+    one independent compensate→compress→exchange pipeline. Shared by the
+    wire models here, the static auditor's payload-contract checks
+    (:mod:`grace_tpu.analysis.flow`), and the per-bucket telemetry pricing,
+    so they can never enumerate different structures."""
+    structs = [jax.ShapeDtypeStruct(tuple(jnp.shape(l)), jnp.result_type(l))
+               for l in leaves]
+    if fusion == "grouped":
+        return [(structs[idxs[0]], len(idxs))
+                for idxs in _group_views(structs)]
+    if fusion is None:
+        return [(s, 1) for s in structs]
+    bucket_bytes = None if fusion == "flat" else int(fusion)
+    buckets, cdtype = _bucketize(
+        [(s.shape, s.dtype) for s in structs], bucket_bytes)
+    return [(jax.ShapeDtypeStruct(
+        (sum(int(np.prod(structs[i].shape, dtype=np.int64))
+             for i in idxs),), jnp.dtype(cdtype)), 1)
+        for idxs in buckets]
+
+
 def fusion_payload_nbytes(compressor: Compressor, leaves, fusion
                           ) -> Tuple[int, int, int]:
     """``(dense_bytes, payload_bytes, n_elems)`` for these gradient leaves
@@ -265,12 +292,13 @@ def fusion_payload_nbytes(compressor: Compressor, leaves, fusion
 
     ``dense_bytes`` is the raw dense gradient size (the codec-blind
     reference), ``payload_bytes`` one rank's whole-gradient wire payload
-    priced over the exact structures the fusion mode compresses, ``n_elems``
-    the dense element count. Module-level so the telemetry wire plan inside
-    :func:`grace_transform` and the static auditor's wire-byte
-    reconciliation pass (:mod:`grace_tpu.analysis`) price payloads with
-    literally the same code — drift between the priced model and the traced
-    graph is then a lint finding, never a silent disagreement.
+    priced over the exact structures the fusion mode compresses
+    (:func:`fusion_payload_structs`), ``n_elems`` the dense element count.
+    Module-level so the telemetry wire plan inside :func:`grace_transform`
+    and the static auditor's wire-byte reconciliation pass
+    (:mod:`grace_tpu.analysis`) price payloads with literally the same code
+    — drift between the priced model and the traced graph is then a lint
+    finding, never a silent disagreement.
     """
     from grace_tpu.utils.metrics import payload_nbytes
 
@@ -279,20 +307,8 @@ def fusion_payload_nbytes(compressor: Compressor, leaves, fusion
     n_elems = sum(int(np.prod(s.shape, dtype=np.int64)) for s in structs)
     dense = sum(int(np.prod(s.shape, dtype=np.int64)) * s.dtype.itemsize
                 for s in structs)
-    if fusion == "grouped":
-        comp_b = sum(payload_nbytes(compressor, structs[idxs[0]]) * len(idxs)
-                     for idxs in _group_views(structs))
-    elif fusion is None:
-        comp_b = sum(payload_nbytes(compressor, s) for s in structs)
-    else:
-        bucket_bytes = None if fusion == "flat" else int(fusion)
-        buckets, cdtype = _bucketize(
-            [(s.shape, s.dtype) for s in structs], bucket_bytes)
-        comp_b = sum(
-            payload_nbytes(compressor, jax.ShapeDtypeStruct(
-                (sum(int(np.prod(structs[i].shape, dtype=np.int64))
-                     for i in idxs),), jnp.dtype(cdtype)))
-            for idxs in buckets)
+    comp_b = sum(payload_nbytes(compressor, s) * count
+                 for s, count in fusion_payload_structs(structs, fusion))
     return dense, comp_b, n_elems
 
 
@@ -360,7 +376,24 @@ def grace_transform(compressor: Compressor, memory: Memory,
       not folded per leaf index), so stochastic codecs draw different —
       equally valid — randomness.
     * ``int`` — greedy whole-leaf buckets of at most this many bytes
-      (Horovod's default fusion threshold is 64 MiB).
+      (Horovod's default fusion threshold is 64 MiB), executed as the
+      **bucketed overlap executor**: K data-independent pipelines, each
+      running its bucket's full compensate→compress→exchange→decompress→
+      memory-update chain under its own rng and its own
+      ``grace/bucket/<b>`` trace scope. Bucket b's collective depends only
+      on bucket b's gradient leaves, so XLA's latency-hiding scheduler can
+      overlap bucket i's exchange with bucket i+1's compression and the
+      tail of the backward pass (DDP-style bucket scheduling) — the
+      contract graft-flow's ``overlap_schedulability`` pass enforces (K
+      independent compress→exchange chains in the traced graph) and
+      graft-prof's measured overlap fraction is sandwiched against.
+      Resilience and accounting stay step-atomic across the split: the
+      guard checks once after ALL buckets land and rolls back the whole
+      step (per-bucket rollback would desync error feedback between
+      buckets), the consensus audit fingerprints the post-apply state as
+      one unit, and the telemetry row sums the per-bucket wire prices
+      (each bucket's collective priced separately through
+      ``recv_link_bytes``) into one step row.
 
     Leaves are cast to their common result dtype inside a fused buffer and
     cast back on return.
@@ -541,6 +574,25 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 new_mem.append(ms)
                 new_comp.append(cs)
         elif fused:
+            # Bucketed overlap executor: K data-independent pipelines, one
+            # per fusion bucket. Each bucket's FULL chain — concatenate its
+            # own leaves, compensate against its own residual buffer,
+            # compress, exchange, decompress, update its own memory — runs
+            # under a per-bucket rng (fold_in(step_key, b)) and touches no
+            # other bucket's values, so bucket b's collective depends only
+            # on bucket b's gradient leaves. That dataflow independence is
+            # the whole point: XLA's latency-hiding scheduler may then run
+            # bucket i's exchange under bucket i+1's compression and under
+            # whatever tail of the backward pass produces later buckets'
+            # gradients (DDP-style bucket scheduling). The contract is
+            # ENFORCED, not hoped for: graft-flow's overlap_schedulability
+            # pass counts the independent compress→exchange chains in the
+            # traced graph and fails lint when fewer than len(buckets)
+            # survive — any accidental cross-bucket dependency introduced
+            # here is a CI error, not a silent serialization. Per-bucket
+            # "grace/bucket/<b>" scopes make each chain attributable in a
+            # device trace (the measured side of the overlap sandwich);
+            # 'flat' is the K=1 degenerate case of the same executor.
             buckets, cdtype = _bucket_views(leaves)
             if len(mem) != len(buckets):
                 raise ValueError(
@@ -551,19 +603,21 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     "the same fusion config).")
             outs = [None] * len(leaves)
             for b, idxs in enumerate(buckets):
-                rng = jax.random.fold_in(step_key, b)
-                flat = jnp.concatenate([jnp.ravel(leaves[i]).astype(cdtype)
-                                        for i in idxs])
-                out, ms, cs = communicator.step(
-                    flat, mem[b], comp[b], memory, compressor, rng)
-                off = 0
-                for i in idxs:
-                    shape = jnp.shape(leaves[i])
-                    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
-                    piece = out[off:off + size]
-                    outs[i] = piece.reshape(shape).astype(
-                        jnp.result_type(leaves[i]))
-                    off += size
+                with trace_stage(f"{STAGE_BUCKET}/{b}"):
+                    rng = jax.random.fold_in(step_key, b)
+                    flat = jnp.concatenate([jnp.ravel(leaves[i]).astype(
+                        cdtype) for i in idxs])
+                    out, ms, cs = communicator.step(
+                        flat, mem[b], comp[b], memory, compressor, rng)
+                    off = 0
+                    for i in idxs:
+                        shape = jnp.shape(leaves[i])
+                        size = int(np.prod(shape, dtype=np.int64)) \
+                            if shape else 1
+                        piece = out[off:off + size]
+                        outs[i] = piece.reshape(shape).astype(
+                            jnp.result_type(leaves[i]))
+                        off += size
                 new_mem.append(ms)
                 new_comp.append(cs)
         else:
@@ -640,8 +694,28 @@ def grace_transform(compressor: Compressor, memory: Memory,
             compressor, structs, fusion)
         vote = bool(getattr(compressor, "vote_aggregate", False))
         topo = topology if topology is not None else Topology.detect()
-        link = communicator.recv_link_bytes(comp_b, n_elems, world,
-                                            topology=topo, vote=vote)
+        if isinstance(fusion, int) and not isinstance(fusion, bool):
+            # The bucketed executor issues one collective CHAIN per bucket,
+            # so the honest model is the sum of per-bucket prices, not one
+            # whole-payload call: for linear schedules (gather/psum) the
+            # two are identical, but ring/two-shot floor-round per
+            # collective — K separate exchanges really do move the
+            # per-bucket-rounded bytes. Pinned against the per-bucket sum
+            # in tests/test_bucketed.py; still inside WIRE_MODEL_RTOL of
+            # the whole-payload recv_wire_bytes the auditor reconciles.
+            from grace_tpu.utils.metrics import payload_nbytes
+            ici = dcn = 0
+            for s, count in fusion_payload_structs(structs, fusion):
+                b_elems = int(np.prod(s.shape, dtype=np.int64))
+                lb = communicator.recv_link_bytes(
+                    payload_nbytes(compressor, s), b_elems, world,
+                    topology=topo, vote=vote)
+                ici += count * lb.ici
+                dcn += count * lb.dcn
+            link = LinkBytes(ici=ici, dcn=dcn)
+        else:
+            link = communicator.recv_link_bytes(comp_b, n_elems, world,
+                                                topology=topo, vote=vote)
         if escape is not None:
             from grace_tpu.comm import Allreduce
             esc_b = sum(payload_nbytes(escape, s) for s in structs)

@@ -200,7 +200,9 @@ def test_bucketed_registry_config_exposes_two_chains():
     t = trace_update(grace, name=entry["name"], meta={"grace": grace})
     s = overlap_summary(t)
     assert flow._expected_chains(t) == 2
-    assert s["independent_chains"] >= 2
+    # EXACTLY the plan's K (chain heads group by gradient-root set, so the
+    # two-tensor top-k payload is one chain per bucket, not two).
+    assert s["independent_chains"] == 2
     assert pass_overlap_schedulability(t) == []
 
 
@@ -465,6 +467,14 @@ def test_graft_lint_all_configs_end_to_end(tmp_path, capsys):
         "memory_footprint"}
     assert all(v == 0 for v in doc["pass_counts"].values())
     assert doc["configs_audited"] == len(AUDIT_CONFIGS)
+    # The static half of the overlap sandwich rides the evidence: every
+    # bucketed (fusion=<int>) update-mode config records its bound and its
+    # chain counts, and the executor delivers exactly the promised K.
+    assert "topk-allgather-bucketed" in doc["overlap_bounds"]
+    assert "qsgd4-ring-packed-bucketed" in doc["overlap_bounds"]
+    for rep in doc["overlap_bounds"].values():
+        assert rep["independent_chains"] == rep["expected_chains"]
+        assert rep["static_overlap_bound"] is not None
 
 
 def test_graft_lint_passes_selection(tmp_path, capsys):

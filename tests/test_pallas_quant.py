@@ -11,8 +11,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from grace_tpu.compressors import QSGDCompressor
-from grace_tpu.ops.pallas_quant import quantize_stochastic
+from grace_tpu.compressors import (QSGDCompressor, SignSGDCompressor,
+                                   SignumCompressor)
+from grace_tpu.ops.packing import pack_4bit, pack_bits, unpack_4bit
+from grace_tpu.ops.pallas_quant import (quantize_pack_stochastic,
+                                        quantize_stochastic, sign_pack)
 
 
 class TestQuantizeStochastic:
@@ -69,6 +72,148 @@ class TestQuantizeStochastic:
                          np.asarray(x)).mean()
         assert err_pal < err_ref * 1.5 + 1e-6
         assert qp.dtype == qr.dtype
+
+
+class TestFusedCompressAndPack:
+    """The compress-and-pack kernels must emit EXACTLY the bytes of the
+    staged 'quantize then reference-pack' path — fusing the pack changes
+    where the wire words are produced, never what they are."""
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 777, 16384, 20000])
+    def test_quantize_pack_bit_identity_vs_staged_pack(self, n):
+        """Fused 4-bit QSGD == quantize_stochastic (same seed, same PRNG
+        stream, same block layout) -> clamp -> nibble fold -> pack_4bit."""
+        rng = np.random.default_rng(n)
+        x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+        norm = jnp.linalg.norm(x)
+        got = np.asarray(quantize_pack_stochastic(x, norm, jnp.int32(5), 7,
+                                                  interpret=True))
+        levels = np.asarray(quantize_stochastic(x, norm, jnp.int32(5), 7,
+                                                interpret=True), np.int32)
+        levels = np.clip(levels, -7, 7)
+        codes = np.where(levels < 0, levels + 16, levels).astype(np.uint8)
+        want = np.asarray(pack_4bit(jnp.asarray(codes)))
+        assert got.shape == want.shape == (-(-n // 2),)
+        np.testing.assert_array_equal(got, want)
+
+    def test_quantize_pack_rejects_wide_quantum(self):
+        with pytest.raises(ValueError, match="4-bit"):
+            quantize_pack_stochastic(jnp.ones(8), jnp.float32(1.0),
+                                     jnp.int32(0), 64, interpret=True)
+
+    @pytest.mark.parametrize("n", [1, 7, 8, 9, 777, 32768, 40000])
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16,
+                                       jnp.float16])
+    def test_sign_pack_bit_identity_vs_pack_bits(self, n, dtype):
+        rng = np.random.default_rng(n)
+        x = jnp.asarray(rng.standard_normal(n), dtype)
+        got = np.asarray(sign_pack(x, interpret=True))
+        want = np.asarray(pack_bits(x >= 0))
+        assert got.shape == want.shape == (-(-n // 8),)
+        np.testing.assert_array_equal(got, want)
+
+    def test_sign_pack_negative_zero(self):
+        """-0.0 >= 0 is True on both paths — the sign-bit edge case."""
+        x = jnp.asarray([-0.0, 0.0, -1.0, 1.0], jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(sign_pack(x, interpret=True)),
+            np.asarray(pack_bits(x >= 0)))
+
+    def test_packed_qsgd_compressor_roundtrip_dtypes_shapes(self):
+        """quantum_num<=7 ships ceil(n/2) packed bytes; decode error stays
+        inside one quantization bin, across dtypes and shapes."""
+        rng = np.random.default_rng(3)
+        key = jax.random.key(0)
+        for shape in [(5,), (33, 7), (128,)]:
+            for dtype in (jnp.float32, jnp.bfloat16):
+                x = jnp.asarray(rng.standard_normal(shape), dtype)
+                for c in (QSGDCompressor(quantum_num=7, use_pallas=False),
+                          QSGDCompressor(quantum_num=7, use_pallas=True)):
+                    (p, norm), ctx, _ = c.compress(x, None, key)
+                    assert p.dtype == jnp.uint8
+                    assert p.shape == (-(-x.size // 2),)
+                    dec = c.decompress((p, norm), ctx)
+                    assert dec.shape == shape and dec.dtype == dtype
+                    err = np.max(np.abs(np.asarray(dec, np.float32)
+                                        - np.asarray(x, np.float32)))
+                    assert err <= float(norm) / 7 + 1e-3
+
+    def test_packed_staged_bytes_decode_by_reference_unpacker(self):
+        """The staged path's wire bytes ARE the pack_widths contract: the
+        module-level unpack_4bit recovers the exact nibble codes."""
+        rng = np.random.default_rng(4)
+        key = jax.random.key(1)
+        x = jnp.asarray(rng.standard_normal(101), jnp.float32)
+        c = QSGDCompressor(quantum_num=7, use_pallas=False)
+        (p, norm), ctx, _ = c.compress(x, None, key)
+        codes = np.asarray(unpack_4bit(p, x.size))
+        levels = np.where(codes >= 8, codes.astype(np.int32) - 16, codes)
+        assert np.abs(levels).max() <= 7
+        dec = np.asarray(c.decompress((p, norm), ctx))
+        np.testing.assert_allclose(
+            dec, float(norm) / 7 * levels.astype(np.float32), rtol=1e-6)
+
+    def test_signsgd_kernel_and_staged_bit_identical(self):
+        rng = np.random.default_rng(5)
+        key = jax.random.key(0)
+        x = jnp.asarray(rng.standard_normal(4097), jnp.float32)
+        (p0,), ctx, _ = SignSGDCompressor(use_pallas=False).compress(
+            x, None, key)
+        (p1,), _, _ = SignSGDCompressor(use_pallas=True).compress(
+            x, None, key)
+        np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+        sm = SignumCompressor(use_pallas=True)
+        (pm,), _, _ = sm.compress(x, sm.init_state(x), key)
+        sm0 = SignumCompressor(use_pallas=False)
+        (pm0,), _, _ = sm0.compress(x, sm0.init_state(x), key)
+        np.testing.assert_array_equal(np.asarray(pm), np.asarray(pm0))
+
+    def test_use_pallas_auto_selects_kernel_on_tpu(self, monkeypatch):
+        """'auto' resolves to the kernel exactly when the backend is a
+        real TPU — and to the staged path elsewhere (no silent interpret-
+        mode slowdowns in production CPU runs)."""
+        for c in (QSGDCompressor(quantum_num=7), SignSGDCompressor()):
+            assert c.use_pallas == "auto"
+            assert c._pallas_mode() == (False, False)       # CPU: staged
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        for c in (QSGDCompressor(quantum_num=7), SignSGDCompressor()):
+            assert c._pallas_mode() == (True, False)        # TPU: kernel
+
+    def test_env_escape_hatch_disables_kernels(self, monkeypatch):
+        monkeypatch.setenv("GRACE_DISABLE_PALLAS", "1")
+        with pytest.warns(RuntimeWarning):
+            assert SignSGDCompressor(
+                use_pallas=True)._pallas_mode() == (False, False)
+
+    def test_packed_qsgd_converges_inside_shard_map(self, mesh):
+        import optax
+        from grace_tpu import grace_from_params
+        from grace_tpu.train import init_train_state, make_train_step
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((256, 12)), jnp.float32)
+        w = rng.standard_normal((12, 3)).astype(np.float32)
+        y = jnp.asarray(np.argmax(np.asarray(x) @ w, axis=1))
+
+        def loss_fn(params, batch):
+            xb, yb = batch
+            logits = xb @ params["w"] + params["b"]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+
+        grc = grace_from_params({"compressor": "qsgd", "quantum_num": 7,
+                                 "memory": "residual",
+                                 "communicator": "allgather",
+                                 "use_pallas": False})
+        tx = optax.chain(grc.transform(seed=1), optax.sgd(0.2))
+        params = {"w": jnp.zeros((12, 3)), "b": jnp.zeros((3,))}
+        state = init_train_state(params, tx, mesh)
+        step = make_train_step(loss_fn, tx, mesh, donate=False)
+        losses = []
+        for _ in range(40):
+            state, loss = step(state, (x, y))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
 
 
 class TestQSGDPallasTraining:

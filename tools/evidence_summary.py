@@ -250,12 +250,21 @@ def build() -> str:
                         f"; all {len(counts)} passes clean")
         else:
             per_pass = ""
+        bounds = lint.get("overlap_bounds") or {}
+        bound_s = ""
+        if bounds:
+            bound_s = ("; bucketed overlap bounds: " + ", ".join(
+                f"{name} static≤{rep.get('static_overlap_bound')} "
+                f"({rep.get('independent_chains')}/"
+                f"{rep.get('expected_chains')} chains)"
+                for name, rep in sorted(bounds.items())
+                if isinstance(rep, dict) and "error" not in rep))
         parts.append(
             f"Static analysis: `graft_lint --all-configs` → "
             f"{lint['errors']} error(s) / {lint.get('warnings', 0)} "
             f"warning(s) over {lint.get('configs_audited', '?')} configs + "
             f"{lint.get('rules_checked', '?')} repo rules"
-            f"{per_pass} "
+            f"{per_pass}{bound_s} "
             f"(`LINT_LAST.json`{', ' + when if when else ''}).")
     prof = _load("PROF_LAST.json")
     if isinstance(prof, dict) and prof.get("stages_ms"):
@@ -267,6 +276,12 @@ def build() -> str:
                 f"ms, top stage {top[0]} ({_fmt(top[1], 3)} ms)"]
         if ov is not None:
             bits.append(f"overlap fraction {100.0 * ov:.1f}%")
+        sand = prof.get("overlap_sandwich")
+        if isinstance(sand, dict):
+            verdict = ("VIOLATED" if sand.get("violations") else "holds")
+            bits.append(
+                f"measured≤static sandwich vs {sand.get('config')} "
+                f"(bound {sand.get('static_overlap_bound')}): {verdict}")
         if steps.get("p50_ms") is not None:
             bits.append(f"step p50 {_fmt(steps['p50_ms'], 3)} ms")
         regr = prof.get("regressions")

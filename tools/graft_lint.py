@@ -41,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # pre-commit hook; --all-configs is the CI spelling.
 CORE_CONFIGS = ("topk-allgather", "none-allreduce", "qsgd-ring",
                 "topk-twoshot", "signsgd-sign_allreduce",
-                "topk-allgather-bucketed",
+                "topk-allgather-bucketed", "qsgd4-allgather-packed",
                 "topk-escape-telemetry", "topk-guard-consensus")
 
 
@@ -148,6 +148,20 @@ def main(argv=None) -> int:
         passes_run = sorted({p for e in configs for p in e["passes"]})
         pass_counts = {p: sum(1 for f in findings if f.pass_name == p)
                        for p in passes_run}
+        # Static overlap bounds for every bucketed (fusion=<int>) config:
+        # the static half of the measured<=possible overlap sandwich, kept
+        # in the evidence so a later chip capture (tools/perf_report.py
+        # --overlap-config) is judged against the bound the lint run that
+        # blessed the schedule actually computed.
+        from grace_tpu.analysis import overlap_bound_report
+        overlap_bounds = {}
+        for e in configs:
+            try:
+                rep = overlap_bound_report(e, world=args.world)
+            except Exception as err:            # noqa: BLE001
+                rep = {"error": f"{type(err).__name__}: {err}"}
+            if rep is not None:
+                overlap_bounds[e["name"]] = rep
         doc = {
             "tool": "graft_lint",
             "errors": sum(1 for f in findings if f.severity == "error"),
@@ -157,6 +171,7 @@ def main(argv=None) -> int:
             "world": args.world,
             "passes_run": passes_run,
             "pass_counts": pass_counts,
+            "overlap_bounds": overlap_bounds,
             "findings": [f.as_dict() for f in findings],
             "captured_at": datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds"),

@@ -108,6 +108,36 @@ def _check_quant() -> None:
     _require(0.05 < went_up.mean() < 0.95, "qsgd rounding is not stochastic")
 
 
+def _check_pack() -> None:
+    # Fused compress-and-pack kernels (ISSUE 10). Unlike the quant check,
+    # BOTH comparisons here are bit-exact ON-CHIP: sign extraction is
+    # deterministic, and the fused qsgd pack shares the quantize kernel's
+    # hw-PRNG stream at equal seed/block layout, so fused == clamp->nibble
+    # ->pack of the plain kernel's levels, byte for byte.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from grace_tpu.ops.packing import pack_4bit, pack_bits
+    from grace_tpu.ops.pallas_quant import (quantize_pack_stochastic,
+                                            quantize_stochastic, sign_pack)
+
+    flat = jax.random.normal(jax.random.key(1), (1_000_003,), jnp.float32)
+    got = np.asarray(sign_pack(flat))
+    want = np.asarray(pack_bits(flat >= 0))
+    _require(np.array_equal(got, want), "sign_pack != pack_bits(x >= 0)")
+
+    norm = jnp.linalg.norm(flat)
+    packed = np.asarray(quantize_pack_stochastic(flat, norm, jnp.int32(7),
+                                                 7))
+    levels = np.clip(np.asarray(
+        quantize_stochastic(flat, norm, jnp.int32(7), 7), np.int32), -7, 7)
+    codes = np.where(levels < 0, levels + 16, levels).astype(np.uint8)
+    _require(np.array_equal(packed, np.asarray(pack_4bit(
+        jnp.asarray(codes)))),
+             "fused quantize_pack != quantize -> clamp -> pack_4bit")
+
+
 def main() -> int:
     import jax
 
@@ -125,11 +155,13 @@ def main() -> int:
 
     try:
         _check_quant()
+        _check_pack()
     except Exception:
         traceback.print_exc()
         print("smoke: QUANT kernel FAILED (topk OK)", file=sys.stderr)
         return 3
-    print("smoke: pallas qsgd-quant kernel OK on", jax.devices()[0])
+    print("smoke: pallas qsgd-quant + compress-and-pack kernels OK on",
+          jax.devices()[0])
     return 0
 
 

@@ -129,6 +129,13 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", default=None,
                     help="write the comparable metric subset to this path "
                          "and exit clean")
+    ap.add_argument("--overlap-config", default=None,
+                    help="registry config name (tools/graft_lint.py --list) "
+                         "to sandwich the trace's measured overlap fraction "
+                         "against: measured must stay <= graft-flow's "
+                         "static schedulability bound (+slack) for that "
+                         "config's traced dataflow; a violation means the "
+                         "capture's attribution is lying and exits 1")
     ap.add_argument("--json", action="store_true",
                     help="emit a JSON document instead of text")
     ap.add_argument("--out", default=DEFAULT_OUT,
@@ -160,6 +167,43 @@ def main(argv=None) -> int:
         doc["baseline_rtol"] = args.rtol
         doc["regressions"] = regressions
 
+    if args.overlap_config:
+        # The measured<=possible overlap sandwich: graft-flow's
+        # schedulability pass computes the byte-weighted static upper bound
+        # the config's dataflow permits, and judges THIS capture's measured
+        # overlap against it (meta['measured_overlap'] — the same hook the
+        # lint tests use). A measured fraction above the bound is not the
+        # scheduler over-performing; it is the trace attribution lying.
+        from grace_tpu.analysis import AUDIT_CONFIGS, build_grace, \
+            overlap_summary, trace_update
+        from grace_tpu.analysis.flow import (OVERLAP_SLACK,
+                                             pass_overlap_schedulability)
+        entry = next((e for e in AUDIT_CONFIGS
+                      if e["name"] == args.overlap_config), None)
+        if entry is None:
+            print(f"unknown config {args.overlap_config!r}; "
+                  "tools/graft_lint.py --list shows the registry",
+                  file=sys.stderr)
+            return 2
+        measured = doc.get("overlap_fraction")
+        grace = build_grace(entry)
+        traced = trace_update(
+            grace, name=entry["name"],
+            meta={"grace": grace, "measured_overlap": measured})
+        bound = overlap_summary(traced)["static_overlap_bound"]
+        sandwich = {
+            "config": entry["name"],
+            "measured_overlap": measured,
+            "static_overlap_bound": (round(bound, 6)
+                                     if bound is not None else None),
+            "slack": OVERLAP_SLACK,
+        }
+        violations = [f.message for f in pass_overlap_schedulability(traced)
+                      if "measured overlap" in f.message]
+        sandwich["violations"] = violations
+        doc["overlap_sandwich"] = sandwich
+        regressions = regressions + violations
+
     if args.write_baseline:
         _atomic_write(args.write_baseline, baseline_view(doc))
         print(f"[perf_report] baseline -> {args.write_baseline}",
@@ -177,6 +221,13 @@ def main(argv=None) -> int:
         print(json.dumps(doc, indent=1))
     else:
         print(analysis.render())
+        if args.overlap_config:
+            s = doc["overlap_sandwich"]
+            print()
+            print(f"overlap sandwich vs {s['config']}: measured="
+                  f"{s['measured_overlap']} <= static bound="
+                  f"{s['static_overlap_bound']} (+{s['slack']} slack): "
+                  + ("VIOLATED" if s["violations"] else "holds"))
         if args.baseline:
             print()
             if regressions:
