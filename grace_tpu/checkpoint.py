@@ -25,8 +25,20 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
-__all__ = ["Checkpointer", "save_checkpoint", "restore_checkpoint",
-           "latest_step", "divergence_rollback"]
+__all__ = ["Checkpointer", "WorldSizeMismatch", "save_checkpoint",
+           "restore_checkpoint", "latest_step", "divergence_rollback"]
+
+
+class WorldSizeMismatch(ValueError):
+    """A checkpoint's leaf shapes differ from the target's only in the
+    leading (world) axis — the signature of restoring a state saved at a
+    different world size W. GraceState mem/comp/telem/watch leaves carry a
+    leading world axis in the global layout, so an elastic resize changes
+    exactly that dim on exactly those leaves. The fix is never to force the
+    restore: re-shard with
+    :func:`grace_tpu.resilience.elastic.reshard_grace_state` (restore at
+    the checkpoint's own world first), or build the target at the
+    checkpoint's world."""
 
 # Transient-IO retry policy for save-path writes (shared by the orbax save
 # dispatch and the last-known-good sidecar): a preempted node's NFS blip or
@@ -79,6 +91,50 @@ def _first_structure_mismatch(stored, target) -> Optional[Tuple[str, str]]:
     if only_stored:
         return only_stored[0], "checkpoint"
     return None
+
+
+def _leaf_meta(tree) -> dict:
+    """path → (shape tuple | None, dtype str | None) for every leaf that
+    exposes shape/dtype (orbax ArrayMetadata, concrete arrays, and
+    eval_shape structs all do; scalars and opaque leaves report None and
+    are skipped by the value-level diff)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    meta = {}
+    for path, leaf in flat:
+        p = "/".join(_path_names(e) for e in path)
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        meta[p] = (tuple(shape) if shape is not None else None,
+                   str(dtype) if dtype is not None else None)
+    return meta
+
+
+def _first_leaf_mismatch(stored, target) -> Optional[Tuple[str, tuple,
+                                                           tuple]]:
+    """First same-path leaf whose shape or dtype differs between the two
+    structures: ``(path, (stored_shape, stored_dtype), (target_shape,
+    target_dtype))``. Only runs when the tree *structures* already agree —
+    the leaf-level refinement of :func:`_first_structure_mismatch`, so a
+    world-size change (same tree, different leading dims) gets a named
+    leaf and both shapes instead of an opaque orbax shape error."""
+    s_meta = _leaf_meta(stored)
+    t_meta = _leaf_meta(target)
+    for path in sorted(s_meta.keys() & t_meta.keys()):
+        (s_shape, s_dtype), (t_shape, t_dtype) = s_meta[path], t_meta[path]
+        if s_shape is None or t_shape is None:
+            continue
+        if s_shape != t_shape or (s_dtype is not None and t_dtype is not None
+                                  and s_dtype != t_dtype):
+            return path, (s_shape, s_dtype), (t_shape, t_dtype)
+    return None
+
+
+def _looks_like_world_resize(s_shape: tuple, t_shape: tuple) -> bool:
+    """Same trailing dims, different leading dim — the global GraceState
+    layout's world axis is the leading axis, so this is the world-size
+    signature (the 'leading axis ratio equals old_W/new_W' case)."""
+    return (len(s_shape) == len(t_shape) and len(s_shape) >= 1
+            and s_shape[0] != t_shape[0] and s_shape[1:] == t_shape[1:])
 
 
 class Checkpointer:
@@ -252,6 +308,31 @@ class Checkpointer:
                 f"{step} under {self.directory}). Restore with a target "
                 "built from the same optimizer/model config the checkpoint "
                 "was written with.")
+        # Same tree, different leaves: name the first offender instead of
+        # letting orbax fail with a raw shape traceback — and recognize
+        # the elastic world-resize signature specifically.
+        leaf = _first_leaf_mismatch(stored, target)
+        if leaf is not None:
+            path, (s_shape, s_dtype), (t_shape, t_dtype) = leaf
+            if _looks_like_world_resize(s_shape, t_shape):
+                raise WorldSizeMismatch(
+                    f"checkpoint leaf '{path}' was saved with shape "
+                    f"{s_shape} but the target expects {t_shape} — same "
+                    "trailing dims, different leading axis: this looks "
+                    f"like a world-size change (checkpoint world "
+                    f"{s_shape[0]}, target world {t_shape[0]}; step {step} "
+                    f"under {self.directory}). Restore into a target built "
+                    f"at world {s_shape[0]}, then re-shard with "
+                    "grace_tpu.resilience.elastic.reshard_grace_state — "
+                    "per-rank state is re-initialized at the new world, "
+                    "never re-partitioned.")
+            raise ValueError(
+                f"checkpoint leaf '{path}' does not match the target: "
+                f"saved shape {s_shape} dtype {s_dtype}, target shape "
+                f"{t_shape} dtype {t_dtype} (checkpoint step {step} under "
+                f"{self.directory}). Restore with a target built from the "
+                "same optimizer/model config the checkpoint was written "
+                "with.")
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

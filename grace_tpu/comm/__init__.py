@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from grace_tpu.core import (Communicator, Compressor, Ctx, LinkBytes,
-                            Payload, SINGLE_SLICE, axis_size)
+                            Payload, SINGLE_SLICE, Topology, axis_size)
 from grace_tpu.telemetry.scopes import (STAGE_DECOMPRESS, STAGE_EXCHANGE,
                                         STAGE_RING_HOP, trace_stage)
 
@@ -808,6 +808,15 @@ class HierarchicalAllreduce(Communicator):
         if self.slice_size is not None and self.slice_size < 1:
             raise ValueError(f"slice_size must be >= 1 or None; "
                              f"got {self.slice_size}")
+
+    def shrunk(self, topology: Topology) -> "HierarchicalAllreduce":
+        """The communicator for a post-resize world described by
+        ``topology`` (typically :meth:`grace_tpu.core.Topology.shrink`'s
+        result): same axis, the surviving slice width. A whole-slice loss
+        keeps ``slice_size`` — the K→K−1 resize never touches the
+        intra-slice schedule — while a partial-slice loss hands back the
+        flat ring (``slice_size=None``), matching the topology collapse."""
+        return dataclasses.replace(self, slice_size=topology.slice_size)
 
     def _split(self, world: int) -> tuple[int, int]:
         """(intra-slice size S, slice count K) for this world. Static."""

@@ -111,6 +111,46 @@ class Topology:
         """True iff a flat collective over ``world`` ranks spans slices."""
         return self.slice_size is not None and world > self.slice_size
 
+    def shrink(self, world: int, lost_ranks) -> Tuple["Topology", int]:
+        """The surviving ``(topology, new_world)`` after an elastic resize
+        removes ``lost_ranks`` from a contiguous world of ``world`` ranks.
+
+        Slice-granular elasticity (ROADMAP item 4): when the lost ranks
+        form *whole* slices, the survivors keep this layout's
+        ``slice_size`` — losing a slice is a K→K−1 DCN-level resize that
+        never touches intra-slice structure, so the hierarchical schedule
+        (and its mixed wire split) survives unchanged. A *partial* slice
+        loss breaks the contiguous-equal-slices contract this descriptor
+        encodes (the survivors of a half-dead slice share no full ICI
+        domain with anyone), so the result collapses to the single-slice
+        flat layout — degraded but honest, the same conservatism as
+        :meth:`detect` refusing uneven slices.
+        """
+        lost = set(int(r) for r in lost_ranks)
+        if not lost:
+            return self, world
+        bad = [r for r in lost if r < 0 or r >= world]
+        if bad:
+            raise ValueError(f"lost_ranks {sorted(bad)} outside the world "
+                             f"[0, {world})")
+        new_world = world - len(lost)
+        if new_world < 1:
+            raise ValueError(f"cannot shrink world {world} by "
+                             f"{len(lost)} ranks — no survivors")
+        if self.slice_size is None:
+            return Topology(), new_world
+        s = self.slice_size
+        if world % s:
+            raise ValueError(f"world {world} is not a multiple of "
+                             f"slice_size {s} — this topology never "
+                             "described that world")
+        whole = all(
+            all(k * s + i in lost for i in range(s))
+            for k in sorted({r // s for r in lost}))
+        if whole:
+            return Topology(slice_size=s), new_world
+        return Topology(), new_world
+
     @classmethod
     def detect(cls, devices=None) -> "Topology":
         """Topology of the live devices: group by the TPU runtime's

@@ -21,6 +21,11 @@ it (SURVEY.md has no counterpart — the reference assumes a fault-free run):
   auditor + in-graph self-healing (fingerprint → compare → masked-psum
   repair → escalate), for the silent single-rank divergence the guard's
   post-exchange checks are structurally blind to.
+* :mod:`~grace_tpu.resilience.elastic` — preemption-tolerant elastic
+  training: graft-watch-driven drain, world-resize GraceState re-sharding
+  (replicated fields carried bit-exactly, per-rank residuals/rings
+  re-initialized at the new W), slice-granular hierarchical shrink, and
+  the consensus-gated rejoin barrier.
 """
 
 from __future__ import annotations
@@ -33,13 +38,21 @@ from grace_tpu.resilience.chaos import (ChaosCommunicator, ChaosCompressor,
                                         ChaosParams)
 from grace_tpu.resilience.consensus import (ConsensusConfig, audit_report,
                                             consensus_step, fingerprint_tree,
-                                            normalize_consensus)
+                                            force_audit, normalize_consensus)
+from grace_tpu.resilience.elastic import (ElasticController, ResizePlan,
+                                          implant_stale_replica, plan_resize,
+                                          rejoin_barrier, replica_variants,
+                                          reshard_grace_state,
+                                          validate_resharded)
 from grace_tpu.resilience.guard import GuardState, guard_transform
 
 __all__ = ["GuardState", "guard_transform", "guarded_chain",
            "ChaosCompressor", "ChaosCommunicator", "ChaosParams",
            "ConsensusConfig", "consensus_step", "fingerprint_tree",
-           "audit_report", "normalize_consensus"]
+           "force_audit", "audit_report", "normalize_consensus",
+           "ElasticController", "ResizePlan", "plan_resize",
+           "reshard_grace_state", "validate_resharded", "rejoin_barrier",
+           "implant_stale_replica", "replica_variants"]
 
 
 def guarded_chain(grace, *txs: optax.GradientTransformation,

@@ -134,6 +134,18 @@ class ChaosCompressor(Compressor):
     def summable_payload(self):  # type: ignore[override]
         return self.inner.summable_payload
 
+    @property
+    def supports_hop_requant(self):  # type: ignore[override]
+        # Delegated like summable_payload: the injector must be able to
+        # ride whatever schedule the inner codec qualifies for (the
+        # ring/hier capability gates read this) — a wrapper that silently
+        # un-qualified topk from the hop-pipelined paths would make the
+        # chaos matrix untestable over exactly the communicators that
+        # matter. Hop re-encodes call this wrapper's compress too, so the
+        # gated rank's faults apply at every requant point — which is what
+        # a degrading encoder on that rank would really do.
+        return self.inner.supports_hop_requant
+
     def init_state(self, x: jax.Array) -> State:
         return self.inner.init_state(x)
 

@@ -82,7 +82,7 @@ from grace_tpu.telemetry.state import FIELD_INDEX, TelemetryState
 from grace_tpu.transform import AuditState, GraceState
 
 __all__ = ["ConsensusConfig", "normalize_consensus", "fingerprint_tree",
-           "consensus_step", "audit_report"]
+           "consensus_step", "force_audit", "audit_report"]
 
 _UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 # Knuth multiplicative-hash constants for the position-weighted fold.
@@ -270,15 +270,7 @@ def consensus_step(tree, consensus, axis_name: str = DEFAULT_AXIS):
     config = normalize_consensus(consensus)
     if config is None:
         return tree
-    graces = _grace_nodes(tree)
-    armed = [g for g in graces if g.audit is not None]
-    if not armed:
-        raise ValueError(
-            "consensus auditing is configured but the state carries no "
-            "AuditState — build the grace transform with consensus=... "
-            "(grace_from_params({'consensus': ...})) and re-init the "
-            "optimizer state, or restore a checkpoint written with a "
-            "consensus-armed transform.")
+    armed = _require_armed(tree)
     # Audit clock: the guard's step counter when a guard wraps the chain —
     # it advances on EVERY step, including guard-skipped ones, so a fault
     # that makes every step roll back (frozen GraceState.count) cannot
@@ -293,6 +285,45 @@ def consensus_step(tree, consensus, axis_name: str = DEFAULT_AXIS):
                     lambda t: _audit(t, config, axis_name),
                     lambda t: t,
                     tree)
+
+
+def _require_armed(tree) -> list:
+    graces = _grace_nodes(tree)
+    armed = [g for g in graces if g.audit is not None]
+    if not armed:
+        raise ValueError(
+            "consensus auditing is configured but the state carries no "
+            "AuditState — build the grace transform with consensus=... "
+            "(grace_from_params({'consensus': ...})) and re-init the "
+            "optimizer state, or restore a checkpoint written with a "
+            "consensus-armed transform.")
+    return armed
+
+
+def force_audit(tree, consensus, axis_name: str = DEFAULT_AXIS):
+    """One UNGATED audit-and-repair pass over ``tree`` — the scheduled
+    :func:`consensus_step` without its every-``audit_every`` ``lax.cond``.
+
+    This is the elastic **rejoin barrier**'s admission gate
+    (:func:`grace_tpu.resilience.elastic.rejoin_barrier`): a rank rejoining
+    the fleet — typically restored from a last-known-good checkpoint taken
+    *before* the fleet kept training — must fingerprint-match the reference
+    replica before its gradients count. The barrier cannot wait for the
+    next scheduled audit (up to ``audit_every`` steps of a stale replica
+    voting in every collective), so it forces the audit at admission time:
+    fingerprint → all_gather → election → masked-broadcast repair of the
+    replicated state, with the divergent (rejoining) rank's residuals
+    zeroed per the PR-3 rationale. Bit-identical to a no-op when the
+    rejoiner already matches. Must run where ``axis_name`` is bound.
+    """
+    config = normalize_consensus(consensus)
+    if config is None:
+        raise ValueError(
+            "force_audit needs an armed consensus config (True / "
+            "audit_every / ConsensusConfig) — None/False disables the "
+            "auditor, which cannot gate a rejoin.")
+    _require_armed(tree)
+    return _audit(tree, config, axis_name)
 
 
 def _audit(tree, config: ConsensusConfig, axis_name: str):

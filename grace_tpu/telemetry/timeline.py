@@ -10,7 +10,7 @@ around step 140?" means hand-joining five record shapes by eye.
 
 :class:`Timeline` is that join. It classifies every record into a **kind**
 (``telemetry`` / ``watch`` / ``anomaly`` / ``guard`` / ``consensus`` /
-``perf`` / ``lint`` / ``other``), orders the whole run by ``(step, file
+``perf`` / ``lint`` / ``elastic`` / ``other``), orders the whole run by ``(step, file
 position)`` — file position breaks ties so causality within a step is
 preserved exactly as the run emitted it — and exposes a small query API
 (:meth:`between`, :meth:`kinds`, :meth:`at_step`, :meth:`anomalies`) plus
@@ -28,7 +28,7 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional
 __all__ = ["KINDS", "classify", "TimelineEvent", "Timeline"]
 
 KINDS = ("telemetry", "watch", "anomaly", "guard", "consensus", "perf",
-         "lint", "other")
+         "lint", "elastic", "other")
 
 
 def classify(record: Mapping[str, Any]) -> str:
@@ -56,6 +56,8 @@ def classify(record: Mapping[str, Any]) -> str:
         return "perf"
     if event == "lint_finding":
         return "lint"
+    if event.startswith("elastic"):
+        return "elastic"
     return "other"
 
 

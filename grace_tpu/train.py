@@ -48,7 +48,7 @@ from grace_tpu.transform import (add_world_axis, partition_specs,
 __all__ = ["TrainState", "StatefulTrainState", "make_train_step",
            "make_stateful_train_step", "make_eval_step",
            "init_train_state", "init_stateful_train_state",
-           "warmup_schedule"]
+           "init_opt_state", "warmup_schedule"]
 
 
 class TrainState(NamedTuple):
@@ -206,16 +206,26 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
     return _lazy_sharded_step(device_step, mesh, axis_name, donate)
 
 
-def _init_opt_state(params: Any, optimizer: optax.GradientTransformation,
-                    mesh: Mesh, axis_name: str) -> Any:
+def init_opt_state(params: Any, optimizer: optax.GradientTransformation,
+                   mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> Any:
     """Optimizer state in the global layout: grace mem/comp leaves get their
-    leading world axis, sharded over ``axis_name``; the rest is replicated."""
+    leading world axis, sharded over ``axis_name``; the rest is replicated.
+
+    Public because it is also the elastic re-shard's fresh-init hook
+    (:func:`grace_tpu.resilience.elastic.reshard_grace_state`): a world
+    resize re-initializes the per-rank GraceState payload by running
+    exactly this init on the NEW mesh, then grafts the old replicated
+    fields back via :func:`grace_tpu.transform.carry_replicated`."""
     abstract = jax.eval_shape(optimizer.init, params)
     specs = partition_specs(abstract, axis_name)
     init_fn = shard_map(
         lambda p: add_world_axis(optimizer.init(p)),
         mesh=mesh, in_specs=(P(),), out_specs=specs, check_vma=False)
     return jax.jit(init_fn)(params)
+
+
+# Back-compat private alias (pre-elastic callers).
+_init_opt_state = init_opt_state
 
 
 def init_train_state(params: Any, optimizer: optax.GradientTransformation,

@@ -119,6 +119,17 @@ class GraceState(NamedTuple):
     watch: Any = None
 
 
+# The GraceState field split every layout-aware consumer agrees on:
+# VARYING fields hold genuinely per-rank data (leading world axis sharded
+# over the mesh in the global view — partition_specs gives them P(axis));
+# REPLICATED fields are bit-identical across ranks (P()) and are exactly
+# what an elastic world-resize carries forward unchanged while the varying
+# fields are re-initialized at the new world (see carry_replicated and
+# grace_tpu.resilience.elastic).
+GRACE_VARYING_FIELDS = ("mem", "comp", "telem", "watch")
+GRACE_REPLICATED_FIELDS = ("count", "rng_key", "fallback", "audit")
+
+
 def _is_grace(x) -> bool:
     return isinstance(x, GraceState)
 
@@ -130,11 +141,9 @@ def _map_grace_varying(fn, tree):
 
     def per_node(node):
         if _is_grace(node):
-            return node._replace(mem=jax.tree_util.tree_map(fn, node.mem),
-                                 comp=jax.tree_util.tree_map(fn, node.comp),
-                                 telem=jax.tree_util.tree_map(fn, node.telem),
-                                 watch=jax.tree_util.tree_map(fn,
-                                                              node.watch))
+            return node._replace(**{
+                name: jax.tree_util.tree_map(fn, getattr(node, name))
+                for name in GRACE_VARYING_FIELDS})
         return node
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -215,6 +224,42 @@ def fallback_flags(tree) -> list:
 
     jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
     return flags
+
+
+def carry_replicated(old_tree, fresh_tree, convert=None):
+    """Graft the replicated payload of ``old_tree`` onto ``fresh_tree``.
+
+    The transform-level re-shard hook of elastic training
+    (:mod:`grace_tpu.resilience.elastic`): ``fresh_tree`` is a
+    freshly-initialized state pytree (same structure, per-rank leaves
+    sized for the NEW world), ``old_tree`` the pre-resize state. Every
+    GraceState keeps the fresh :data:`GRACE_VARYING_FIELDS`
+    (mem/comp/telem/watch — re-initialized, never re-partitioned; see
+    IMPLEMENTING.md, "Why re-shard re-initializes residuals") and takes
+    the old :data:`GRACE_REPLICATED_FIELDS` (count/rng_key/fallback/audit)
+    bit-exactly; every non-GraceState leaf (params-adjacent optimizer
+    state, guard counters) is carried from ``old_tree`` — those are
+    replicated by the ``partition_specs`` contract. ``convert`` (e.g. a
+    ``device_put`` onto the new mesh) is applied to each carried leaf.
+    ``old_tree`` may hold ``None`` in the varying fields (a stripped
+    :func:`~grace_tpu.resilience.consensus.replicated_view`) — only its
+    replicated payload is read."""
+    conv = convert if convert is not None else (lambda x: x)
+
+    def graft(old, fresh):
+        if _is_grace(old):
+            if not _is_grace(fresh):
+                raise ValueError(
+                    "carry_replicated: old tree has a GraceState where the "
+                    f"fresh tree has {type(fresh).__name__} — the two "
+                    "states were built from different optimizer chains.")
+            return fresh._replace(**{
+                name: jax.tree_util.tree_map(conv, getattr(old, name))
+                for name in GRACE_REPLICATED_FIELDS})
+        return conv(old)
+
+    return jax.tree_util.tree_map(graft, old_tree, fresh_tree,
+                                  is_leaf=_is_grace)
 
 
 def _bucketize(shapes_dtypes, bucket_bytes: Optional[int]):
@@ -431,9 +476,12 @@ def grace_transform(compressor: Compressor, memory: Memory,
     ``Communicator.recv_link_bytes`` under this topology (flat
     communicators therefore report the all-ICI split within one slice and
     all-DCN beyond it; the hierarchical communicator reports a genuinely
-    mixed split). ``None`` auto-detects the live layout
-    (``Topology.detect()`` — a single slice on CPU/simulated meshes, which
-    is the documented all-ICI fallback for flat comms).
+    mixed split). ``None`` auto-detects the live layout ONCE, at build
+    time (``Topology.detect()`` — a single slice on CPU/simulated meshes,
+    which is the documented all-ICI fallback for flat comms); every wire
+    consumer inside the transform then shares that single resolved object,
+    so an elastic world resize invalidates the topology by rebuilding the
+    transform and nowhere else.
 
     ``consensus`` (None | True | int ``audit_every`` | dict |
     ``ConsensusConfig``): arm the cross-rank consistency auditor
@@ -493,6 +541,15 @@ def grace_transform(compressor: Compressor, memory: Memory,
             "communicator whole buffers to shard.")
     bucket_bytes = None if fusion == "flat" else fusion
     fused = fusion is not None and not grouped
+    # Resolve the link topology ONCE, at build time. Both consumers below
+    # (the wire-plan pricing and the watch-gather link fold) close over this
+    # single object, so they can never disagree — and an elastic world
+    # resize has exactly one invalidation point: rebuild the transform
+    # (which a resize must do anyway to re-size the per-rank state).
+    # Detection is only needed when telemetry prices a per-link split.
+    resolved_topology = topology
+    if resolved_topology is None and telemetry is not None:
+        resolved_topology = Topology.detect()
 
     def _bucket_views(leaves):
         """Static bucketing plan for these leaves: (buckets, common dtype)."""
@@ -693,7 +750,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
         dense, comp_b, n_elems = fusion_payload_nbytes(
             compressor, structs, fusion)
         vote = bool(getattr(compressor, "vote_aggregate", False))
-        topo = topology if topology is not None else Topology.detect()
+        topo = resolved_topology
         if isinstance(fusion, int) and not isinstance(fusion, bool):
             # The bucketed executor issues one collective CHAIN per bucket,
             # so the honest model is the sum of per-bucket prices, not one
@@ -848,8 +905,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 # but split by link too: the health gather is a flat
                 # full-axis collective, so it rides ICI within one slice
                 # and DCN beyond it, exactly like the escape psum.
-                topo = topology if topology is not None \
-                    else Topology.detect()
+                topo = resolved_topology
                 wb = jnp.where(due, jnp.asarray(
                     float(watch_gather_bytes(world)), jnp.float32), 0.0)
                 eff = eff + wb
@@ -906,4 +962,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                                audit=state.audit, watch=watch_state)
         return jax.tree_util.tree_unflatten(treedef, outs), new_state
 
+    # The one resolved topology object both pricing paths close over —
+    # exposed so tests can pin the single-invalidation-point contract
+    # (None when telemetry is off: nothing prices a per-link split).
+    update.grace_topology = resolved_topology
     return optax.GradientTransformation(init, update)

@@ -159,6 +159,68 @@ class TestStructureMismatch:
             restore_checkpoint(tmp_path / "c", adam_state)
 
 
+class TestLeafMismatch:
+    """Same tree structure, different leaf shapes/dtypes must name the
+    offending leaf — and recognize the elastic world-resize signature
+    (same trailing dims, different leading axis) with a dedicated
+    WorldSizeMismatch hint (ISSUE 11 satellite)."""
+
+    def test_world_resize_raises_worldsize_mismatch(self, tmp_path):
+        from grace_tpu.checkpoint import WorldSizeMismatch
+
+        state = {"opt": {"mem": jnp.zeros((8, 16, 4))},
+                 "w": jnp.ones((16, 4))}
+        save_checkpoint(tmp_path / "c", state, step=1)
+        target = {"opt": {"mem": jnp.zeros((6, 16, 4))},
+                  "w": jnp.ones((16, 4))}
+        with pytest.raises(WorldSizeMismatch, match="opt/mem") as ei:
+            restore_checkpoint(tmp_path / "c", target)
+        msg = str(ei.value)
+        assert "(8, 16, 4)" in msg and "(6, 16, 4)" in msg
+        assert "checkpoint world 8" in msg and "target world 6" in msg
+        assert "reshard_grace_state" in msg
+        # WorldSizeMismatch stays a ValueError: existing callers that
+        # catch the structure-mismatch error keep working
+        assert isinstance(ei.value, ValueError)
+
+    def test_plain_shape_change_names_leaf_and_both_shapes(self, tmp_path):
+        from grace_tpu.checkpoint import WorldSizeMismatch
+
+        state = {"w": jnp.ones((4, 2))}
+        save_checkpoint(tmp_path / "c", state, step=1)
+        with pytest.raises(ValueError, match="'w'") as ei:
+            restore_checkpoint(tmp_path / "c", {"w": jnp.ones((2, 4))})
+        assert "(4, 2)" in str(ei.value) and "(2, 4)" in str(ei.value)
+        assert not isinstance(ei.value, WorldSizeMismatch)
+
+    def test_dtype_change_names_leaf_and_both_dtypes(self, tmp_path):
+        state = {"w": jnp.ones((4, 2), jnp.float32)}
+        save_checkpoint(tmp_path / "c", state, step=1)
+        with pytest.raises(ValueError, match="'w'") as ei:
+            restore_checkpoint(tmp_path / "c",
+                               {"w": jnp.ones((4, 2), jnp.int32)})
+        assert "float32" in str(ei.value) and "int32" in str(ei.value)
+
+    def test_grace_state_world_resize_hint(self, mesh, tmp_path):
+        """The real case: a W=8 train state restored into a W=6 target."""
+        from grace_tpu.checkpoint import WorldSizeMismatch
+        from grace_tpu.parallel import data_parallel_mesh
+
+        state, step, batch = _setup(mesh)
+        save_checkpoint(tmp_path / "c", state, step=1)
+        grc = grace_from_params({"compressor": "topk",
+                                 "compress_ratio": 0.1,
+                                 "memory": "residual",
+                                 "communicator": "allgather"})
+        tx = optax.chain(grc.transform(seed=0), optax.sgd(1e-2))
+        params = {"w": jnp.ones((16, 4)), "b": jnp.zeros((4,))}
+        target6 = init_train_state(
+            params, tx, data_parallel_mesh(jax.devices()[:6]))
+        with pytest.raises(WorldSizeMismatch,
+                           match="checkpoint world 8, target world 6"):
+            restore_checkpoint(tmp_path / "c", target6)
+
+
 class TestLastKnownGood:
     def test_restore_last_good_picks_newest_good(self, tmp_path):
         with Checkpointer(tmp_path / "g", max_to_keep=None) as ckpt:

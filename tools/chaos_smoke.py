@@ -54,6 +54,22 @@ window, attributing the exact rank, before any guard/consensus event
 exists. Combine with ``--sdc`` to cross-validate: the consensus repair
 zeroes the SDC rank's residuals, which the watch skew detector also sees.
 
+Elastic scenario (ISSUE 11): ``--elastic`` runs the full preemption
+lifecycle on the 8-device mesh — drift on one rank (guard-blind, like
+``--watch``) until graft-watch flags it, the :class:`ElasticController`
+drains (last-known-good ``Checkpointer`` save), the flagged rank is killed
+and the run RESUMES at W−1 (``reshard_grace_state``: replicated state
+carried bit-exactly, per-rank residuals/rings re-initialized, validated
+against flow pass 7's footprint model), then the rank REJOINS at W with
+params restored from the stale pre-departure checkpoint and must pass the
+consensus-gated rejoin barrier (one forced fingerprint audit; repairs ==
+rejoins, replicas bit-identical after). With ``--hier`` the kill takes the
+flagged rank's WHOLE slice — a K→K−1 DCN-level resize that keeps
+``slice_size``. Evidence (resize events, rejoin fingerprint pricing,
+convergence-floor verdict, per-world footprint checks) lands in
+``--elastic-out`` (ELASTIC_LAST.json), rendered by evidence_summary.py;
+``elastic_*`` events additionally stream into the telemetry JSONL.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py            # defaults
@@ -61,6 +77,8 @@ Usage::
     python tools/chaos_smoke.py --sdc                        # + param SDC
     python tools/chaos_smoke.py --sdc --hier --slice-size 4  # hier matrix
     python tools/chaos_smoke.py --watch --watch-rank 3       # drift watch
+    python tools/chaos_smoke.py --elastic                    # kill + rejoin
+    python tools/chaos_smoke.py --elastic --hier --slice-size 4  # slice kill
 """
 
 from __future__ import annotations
@@ -132,6 +150,24 @@ def main(argv=None) -> int:
     ap.add_argument("--watch-window", type=int, default=10,
                     help="steps between in-graph cross-rank health "
                          "summaries (with --watch)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the full elastic lifecycle: drift → watch "
+                         "drain signal → kill the flagged rank (its whole "
+                         "slice with --hier) → resume at W-1 → rejoin at W "
+                         "behind the consensus fingerprint barrier. "
+                         "Disables NaN injection (the faults here are "
+                         "drift and staleness; the guard must stay silent)")
+    ap.add_argument("--elastic-rank", type=int, default=5,
+                    help="mesh index that degrades and dies (with "
+                         "--elastic; under --hier its whole slice is lost)")
+    ap.add_argument("--elastic-out", default="ELASTIC_LAST.json",
+                    help="evidence JSON path for --elastic ('' disables)")
+    ap.add_argument("--floor", type=float, default=2.25,
+                    help="convergence floor: the post-rejoin final loss "
+                         "must be below this (10-class CE starts ~2.303)")
+    ap.add_argument("--ckpt-dir", default="",
+                    help="checkpoint directory for the elastic drain "
+                         "(default: a fresh temp dir)")
     ap.add_argument("--lint", action="store_true",
                     help="first run graft-lint (repo rules + a static "
                          "audit of this smoke's own grace config); "
@@ -154,6 +190,9 @@ def main(argv=None) -> int:
             # before any test ran. Reuse its devices.
             pass
         relax_cpu_collective_timeouts()
+
+    if args.elastic:
+        return _elastic_main(args)
 
     import jax.numpy as jnp
     import numpy as np
@@ -425,6 +464,300 @@ def main(argv=None) -> int:
             print("[chaos_smoke] FAIL: param replicas still diverged after "
                   "the final audit window", file=sys.stderr)
             return 1
+    print("[chaos_smoke] OK")
+    return 0
+
+
+def _elastic_main(args) -> int:
+    """The --elastic lifecycle: drift → drain → kill → W−1 resume → rejoin
+    → W, with the consensus barrier gating the rejoin. Returns 0 only when
+    every acceptance fact holds (see module docstring)."""
+    import dataclasses
+    import json
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from grace_tpu import grace_from_params
+    from grace_tpu.checkpoint import Checkpointer
+    from grace_tpu.core import Topology
+    from grace_tpu.models import lenet
+    from grace_tpu.parallel import data_parallel_mesh
+    from grace_tpu.resilience import (ChaosCompressor, ConsensusConfig,
+                                      ElasticController, guarded_chain,
+                                      plan_resize, validate_resharded)
+    from grace_tpu.telemetry import JSONLSink, TelemetryReader
+    from grace_tpu.train import init_train_state, make_train_step
+    from grace_tpu.utils.logging import GuardMonitor, run_provenance
+    from grace_tpu.utils.metrics import guard_report
+
+    devices = jax.devices()
+    world = len(devices)
+    doomed = args.elastic_rank
+    if args.hier:
+        if world % args.slice_size:
+            print(f"[chaos_smoke] --elastic --hier: world {world} not a "
+                  f"multiple of slice_size {args.slice_size}",
+                  file=sys.stderr)
+            return 1
+        k = doomed // args.slice_size
+        lost = tuple(range(k * args.slice_size, (k + 1) * args.slice_size))
+        topo = Topology(slice_size=args.slice_size)
+    else:
+        lost = (doomed,)
+        topo = Topology()
+    plan = plan_resize(world, lost, topo)
+    # Phase split: A (full world, drift until drained), B (survivors),
+    # C (post-rejoin, where the convergence floor is judged).
+    steps_a = max(args.steps // 3, 2 * args.watch_window)
+    steps_b = max(args.steps // 4, 4)
+    steps_c = max(args.steps - steps_a - steps_b, 4)
+
+    consensus = ConsensusConfig(audit_every=args.audit_every)
+
+    def build(slice_size, drift_rank=None):
+        """(grace, guarded tx) for one phase. Rebuilding the transform is
+        the resize's single topology-invalidation point."""
+        p = {"compressor": "topk", "compress_ratio": 0.3,
+             "memory": "residual", "communicator": "allgather",
+             "escape": "fp16", "consensus": consensus,
+             "telemetry": max(2 * args.telemetry_every, 16),
+             "watch": {"window": args.watch_window,
+                       "capacity": max(2 * args.telemetry_every
+                                       // args.watch_window, 8)}}
+        if args.hier:
+            # A whole-slice loss keeps slice_size (the K→K−1 resize);
+            # a partial loss would hand back None and the flat schedule —
+            # exactly HierarchicalAllreduce.shrunk's contract.
+            p.update(communicator="hier", fusion="flat")
+            if slice_size:
+                p["slice_size"] = slice_size
+        grc = grace_from_params(p)
+        if drift_rank is not None:
+            grc = dataclasses.replace(grc, compressor=ChaosCompressor(
+                inner=grc.compressor, drift_scale=args.drift_scale,
+                rank=drift_rank, seed=args.seed + 3))
+        tx = guarded_chain(grc, optax.sgd(args.lr),
+                           fallback_after=args.fallback_after,
+                           fallback_steps=args.fallback_steps)
+        return grc, tx
+
+    def batches(w):
+        b = max(args.batch, w) // w * w
+        rng = np.random.default_rng(args.seed)
+        images = rng.normal(size=(4 * args.batch, 28, 28, 1)).astype(
+            np.float32)
+        labels = rng.integers(0, 10, size=(4 * args.batch,)).astype(np.int32)
+
+        def at(i):
+            lo = (i * b) % (len(images) - b + 1)
+            return (jnp.asarray(images[lo:lo + b]),
+                    jnp.asarray(labels[lo:lo + b]))
+        return at
+
+    def loss_fn(params, b):
+        x, y = b
+        logits, _ = lenet.apply(params, {}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    sink = None
+    reader = None
+    if args.telemetry_out:
+        sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+            data="synthetic", tool="chaos_smoke",
+            argv=" ".join(sys.argv[1:]), steps=args.steps,
+            elastic=True, elastic_rank=doomed, hier=args.hier))
+        reader = TelemetryReader(sink, every=args.telemetry_every,
+                                 anomaly=True)
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="grace_elastic_")
+    ckpt = Checkpointer(ckpt_dir, max_to_keep=2)
+    controller = ElasticController(consensus=consensus, checkpointer=ckpt,
+                                   sink=sink, anomaly_threshold=1)
+    monitor = GuardMonitor(sink=sink)
+
+    # ---- phase A: full world, one rank drifting -------------------------
+    mesh_a = data_parallel_mesh(devices)
+    grc_a, tx_a = build(args.slice_size if args.hier else None,
+                        drift_rank=doomed)
+    params, _ = lenet.init(jax.random.key(args.seed))
+    state = init_train_state(params, tx_a, mesh_a)
+    step = make_train_step(loss_fn, tx_a, mesh_a, donate=False,
+                           consensus=consensus)
+    at = batches(world)
+    t0 = time.perf_counter()
+    first_loss = None
+    drain_rank = None
+    drain_step = None
+    seen_anomalies = 0
+    for i in range(steps_a):
+        state, loss = step(state, at(i))
+        if first_loss is None:
+            first_loss = float(loss)
+        monitor.update(i, guard_report(state))
+        if reader is not None:
+            reader.update(i, state)
+            anomalies = reader.monitor.anomalies
+            rank = controller.observe(i, anomalies[seen_anomalies:])
+            seen_anomalies = len(anomalies)
+            if rank is not None and drain_rank is None:
+                drain_rank = rank
+                drain_step = i
+                controller.drain(i, state, rank)
+    if reader is not None and drain_rank is None:
+        # Tail window: the last flush may hold the first episode.
+        reader.flush(state)
+        rank = controller.observe(
+            steps_a - 1, reader.monitor.anomalies[seen_anomalies:])
+        if rank is not None:
+            drain_rank, drain_step = rank, steps_a - 1
+            controller.drain(steps_a - 1, state, rank)
+    if reader is not None and drain_rank is None:
+        print("[chaos_smoke] FAIL: seeded drift on rank "
+              f"{doomed} produced no watch drain signal in {steps_a} "
+              "steps — the early-warning channel is broken", file=sys.stderr)
+        return 1
+    if reader is None:
+        # No telemetry stream to carry the warning — drain unconditionally
+        # so the lifecycle below still runs (documented degraded mode).
+        controller.drain(steps_a - 1, state, doomed)
+        drain_rank, drain_step = doomed, steps_a - 1
+    if drain_rank != doomed and not args.hier:
+        print(f"[chaos_smoke] FAIL: drain signal named rank {drain_rank}, "
+              f"but rank {doomed} is the one drifting", file=sys.stderr)
+        return 1
+    guard_a = guard_report(state)
+    if guard_a["notfinite_count"] != 0:
+        print("[chaos_smoke] FAIL: guard tripped during the drift phase — "
+              "the elastic faults are supposed to be guard-invisible",
+              file=sys.stderr)
+        return 1
+
+    # ---- kill + resize to the survivor world ----------------------------
+    survivors = [devices[r] for r in plan.survivors]
+    mesh_b = data_parallel_mesh(survivors)
+    grc_b, tx_b = build(plan.topology.slice_size)
+    state_b, resize_down = controller.resize(
+        steps_a, state, tx_b, mesh_a, mesh_b, plan,
+        grace=grc_b, params=params)
+    print(f"[chaos_smoke] resize: W{plan.old_world} -> W{plan.new_world} "
+          f"(lost {list(plan.lost_ranks)}, slice_size "
+          f"{plan.topology.slice_size}, footprint_matches "
+          f"{resize_down['footprint_matches']})")
+
+    # ---- phase B: survivors keep training -------------------------------
+    step_b = make_train_step(loss_fn, tx_b, mesh_b, donate=False,
+                             consensus=consensus)
+    at_b = batches(plan.new_world)
+    loss_b = float("nan")
+    for i in range(steps_a, steps_a + steps_b):
+        state_b, loss_b = step_b(state_b, at_b(i))
+        if reader is not None:
+            reader.update(i, state_b)
+    if not np.isfinite(float(loss_b)):
+        print("[chaos_smoke] FAIL: loss went non-finite at the survivor "
+              f"world W{plan.new_world}", file=sys.stderr)
+        return 1
+
+    # ---- rejoin at full world behind the consensus barrier --------------
+    mesh_c = data_parallel_mesh(devices)
+    grc_c, tx_c = build(args.slice_size if args.hier else None)
+    grow = plan_resize(world, (), topo)   # no losses: W stays, fresh plan
+    state_c, _ = controller.resize(
+        steps_a + steps_b, state_b, tx_c, mesh_b, mesh_c,
+        dataclasses.replace(grow, old_world=plan.new_world),
+        grace=grc_c, params=params)
+    # The rejoining rank(s) come back with the state they drained with —
+    # restore the last-known-good checkpoint and implant it on exactly
+    # the replicas that left, which is what a preempted process restoring
+    # from disk looks like to the survivors.
+    from grace_tpu.resilience import implant_stale_replica
+    stale = ckpt.restore_last_good(state_c)
+    for r in plan.lost_ranks:
+        state_c = implant_stale_replica(state_c, r, stale.params)
+    state_c, barrier = controller.rejoin(steps_a + steps_b, state_c, mesh_c)
+    print(f"[chaos_smoke] rejoin: barrier_repairs "
+          f"{barrier['barrier_repairs']} | replica_variants "
+          f"{barrier['replica_variants']} | divergent rank "
+          f"{barrier['last_divergent_rank']} | fingerprint "
+          f"{barrier['fingerprint_bytes']} B")
+    if barrier["barrier_repairs"] != 1:
+        print(f"[chaos_smoke] FAIL: rejoin barrier repaired "
+              f"{barrier['barrier_repairs']} times for 1 rejoin event — "
+              "repairs must equal rejoins", file=sys.stderr)
+        return 1
+    if barrier["replica_variants"] != 1:
+        print("[chaos_smoke] FAIL: replicas not bit-identical after the "
+              "rejoin barrier", file=sys.stderr)
+        return 1
+
+    # ---- phase C: full world again, judge the floor ---------------------
+    step_c = make_train_step(loss_fn, tx_c, mesh_c, donate=False,
+                             consensus=consensus)
+    at_c = batches(world)
+    loss_c = float("nan")
+    for i in range(steps_a + steps_b, steps_a + steps_b + steps_c):
+        state_c, loss_c = step_c(state_c, at_c(i))
+        monitor.update(i, guard_report(state_c))
+        if reader is not None:
+            reader.update(i, state_c)
+    loss_c = float(loss_c)
+    dt = time.perf_counter() - t0
+    if reader is not None:
+        reader.flush(state_c)
+        reader.close()
+    ckpt.close()
+
+    fp_down = bool(resize_down["footprint_matches"])
+    fp_up = validate_resharded(state_c, grc_c, params, world)["matches"]
+    floor_met = np.isfinite(loss_c) and loss_c < args.floor
+    print(f"[chaos_smoke] elastic: {steps_a}+{steps_b}+{steps_c} steps in "
+          f"{dt:.1f}s | W {plan.old_world}->{plan.new_world}->{world} | "
+          f"loss {first_loss:.4f} -> {loss_c:.4f} (floor {args.floor}) | "
+          f"drain rank {drain_rank} @ step {drain_step}")
+
+    if args.elastic_out:
+        doc = {
+            "tool": "chaos_smoke",
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "argv": " ".join(sys.argv[1:]),
+            "world_cycle": [plan.old_world, plan.new_world, world],
+            "hier": bool(args.hier),
+            "slice_size": args.slice_size if args.hier else None,
+            "drain": {"rank": drain_rank, "step": drain_step,
+                      "episodes": controller.episodes.get(drain_rank, 0)},
+            "resize_events": controller.events,
+            "rejoin": {"rejoins": 1, **{
+                k: int(barrier[k]) for k in
+                ("barrier_repairs", "repairs", "audits", "replica_variants",
+                 "last_divergent_rank", "fingerprint_bytes",
+                 "repair_bytes")}},
+            "floor": {"first_loss": first_loss, "final_loss": loss_c,
+                      "floor": args.floor, "met": bool(floor_met)},
+            "footprint": {str(plan.new_world): fp_down, str(world): fp_up},
+        }
+        tmp = args.elastic_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.elastic_out)
+        print(f"[chaos_smoke] elastic evidence: {args.elastic_out}")
+
+    if not np.isfinite(loss_c):
+        print("[chaos_smoke] FAIL: final loss non-finite after the rejoin",
+              file=sys.stderr)
+        return 1
+    if not floor_met:
+        print(f"[chaos_smoke] FAIL: final loss {loss_c:.4f} misses the "
+              f"convergence floor {args.floor}", file=sys.stderr)
+        return 1
+    if not (fp_down and fp_up):
+        print("[chaos_smoke] FAIL: re-sharded state does not match the "
+              "static footprint model", file=sys.stderr)
+        return 1
     print("[chaos_smoke] OK")
     return 0
 

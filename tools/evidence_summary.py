@@ -5,6 +5,7 @@ Reads (all repo-root, all optional — missing files are skipped):
   BENCH_ALL_TPU_LAST.json  per-algorithm TPU sweep
   BENCH_ALL_CPU.json       per-algorithm CPU-mesh smoke sweep
   TPU_VARIANTS.jsonl       selection-variant session rows
+  ELASTIC_LAST.json        chaos_smoke --elastic resize/rejoin evidence
 
 Usage: python tools/evidence_summary.py [--update-readme]
 Prints markdown to stdout; --update-readme splices it between the
@@ -293,6 +294,40 @@ def build() -> str:
             f"Performance attribution: `perf_report --trace "
             f"{prof.get('trace', '?')}` → " + ", ".join(bits) +
             f" (`PROF_LAST.json`{', ' + when if when else ''}){note}.")
+    elastic = _load("ELASTIC_LAST.json")
+    if isinstance(elastic, dict) and elastic.get("tool") == "chaos_smoke":
+        when = (elastic.get("captured_at") or "").split("T")[0]
+        cycle = " → ".join(str(w) for w in (elastic.get("world_cycle") or []))
+        resizes = elastic.get("resize_events") or []
+        rejoin = elastic.get("rejoin") or {}
+        floor = elastic.get("floor") or {}
+        fp = elastic.get("footprint") or {}
+        bits = [f"world cycle {cycle}" if cycle else "no resize recorded",
+                f"{len(resizes)} resize event(s)"]
+        if rejoin:
+            verdict = ("bit-identical" if rejoin.get("replica_variants") == 1
+                       else f"{rejoin.get('replica_variants')} variants")
+            bits.append(
+                f"rejoin barrier: {rejoin.get('barrier_repairs', '?')} "
+                f"repair(s) for {rejoin.get('rejoins', '?')} rejoin(s), "
+                f"replicas {verdict} "
+                f"(fingerprint {rejoin.get('fingerprint_bytes', '?')} B)")
+        if floor:
+            met = "met" if floor.get("met") else "MISSED"
+            bits.append(f"convergence floor {met} "
+                        f"(final loss {_fmt(floor.get('final_loss'), 4)} vs "
+                        f"floor {_fmt(floor.get('floor'), 2)})")
+        if fp:
+            ok = all(bool(v) for v in fp.values())
+            bits.append("re-shard footprint vs flow pass 7 model: "
+                        + ("matches at "
+                           + ", ".join(f"W={k}" for k in sorted(fp))
+                           if ok else f"MISMATCH {fp}"))
+        parts.append("")
+        parts.append(
+            "Elastic training (graft-elastic): `chaos_smoke --elastic` → "
+            + ", ".join(bits)
+            + f" (`ELASTIC_LAST.json`{', ' + when if when else ''}).")
     watch = _load("WATCH_LAST.json")
     if isinstance(watch, dict) and watch.get("tool") == "graft_watch":
         when = (watch.get("captured_at") or "").split("T")[0]
