@@ -929,6 +929,67 @@ def load_tpu_evidence(path: str = TPU_EVIDENCE_PATH):
         return None
 
 
+STALE_BANNER = "STALE — predates PRs 7–10"
+
+
+def evidence_staleness(doc) -> list:
+    """Why a persisted TPU evidence document predates the current feature
+    set — the honesty check every reader of these files applies before
+    quoting a headline (ISSUE 12). Empty list = current.
+
+    The detectors are the stamps the perf PRs introduced, so a fresh
+    capture clears them all by construction:
+
+    * PR 10 stamps ``pallas_enabled``/``fusion`` into the document-level
+      ``run_provenance`` and a first-class ``fusion`` key onto every row —
+      a document without them was captured before the bucketed executor
+      and the fused pack kernels existed;
+    * PR 7's hierarchical communicator: a sweep with no ``hier`` row never
+      measured the two-level schedule the W≥64 projections ride on.
+
+    A stale document is still evidence — of the machine state at its
+    ``captured_at`` — it just must not be presented as the current
+    system's number, which is what the ``STALE`` banner enforces in
+    ``tools/evidence_summary.py`` and the ``last_tpu`` carry-along.
+    """
+    if not isinstance(doc, dict):
+        return []
+    reasons = []
+    prov = doc.get("provenance")
+    if not isinstance(prov, dict):
+        reasons.append(
+            "no run_provenance block — the capture predates the "
+            "document-level provenance stamp (git commit unknown)")
+    elif "pallas_enabled" not in prov or "fusion" not in prov:
+        reasons.append(
+            "provenance lacks the pallas_enabled/fusion stamps (PR 10): "
+            "the headline cannot say which executor/kernel path it "
+            "measured")
+    rows = [r for r in (doc.get("rows") or [])
+            if isinstance(r, dict) and r.get("config")]
+    measured = [r for r in rows if "imgs_per_sec" in r
+                or "tokens_per_sec" in r]
+    if measured and not any("fusion" in r for r in measured):
+        reasons.append(
+            "rows predate the first-class fusion row stamp (PR 10)")
+    if len(measured) > 2:        # a sweep, not the 2-row headline pair
+        comms = {(r.get("grace_params") or {}).get("communicator")
+                 for r in measured}
+        if not comms & {"hier", "hierarchical", "hier_allreduce"}:
+            reasons.append(
+                "no hierarchical (ICI×DCN) row — the sweep predates PR 7; "
+                "refresh with `bench_all --tuned`")
+    return reasons
+
+
+def _mark_stale(doc):
+    """A copy of ``doc`` carrying the stale banner when it earned one."""
+    reasons = evidence_staleness(doc)
+    if not reasons:
+        return doc
+    return {**doc, "stale": STALE_BANNER, "stale_reasons": reasons}
+
+
 SWEEP_SUMMARY_PATH = os.path.join(os.path.dirname(TPU_EVIDENCE_PATH),
                                   "BENCH_ALL_TPU_LAST.json")
 
@@ -955,12 +1016,19 @@ def load_tpu_sweep_summary(path: str = SWEEP_SUMMARY_PATH):
 def _attach_tpu_evidence(d: dict) -> None:
     """Attach the latest persisted on-TPU records to a non-TPU result —
     one helper for both the parse() and emit_failure() sites so the two
-    outputs can never drift."""
+    outputs can never drift. Stale records (evidence_staleness) carry the
+    banner so a carried-along number is never mistaken for a capture of
+    the current feature set."""
     last = load_tpu_evidence()
     if last:
-        d["last_tpu"] = last
+        d["last_tpu"] = _mark_stale(last)
     sweep = load_tpu_sweep_summary()
     if sweep:
+        # The summary is trimmed; staleness is judged on the full document.
+        reasons = evidence_staleness(load_tpu_evidence(SWEEP_SUMMARY_PATH))
+        if reasons:
+            sweep = {**sweep, "stale": STALE_BANNER,
+                     "stale_reasons": reasons}
         d["last_tpu_sweep"] = sweep
 
 

@@ -140,6 +140,29 @@ CONFIGS = [
                                      "communicator": "hier",
                                      "slice_size": 8,
                                      "fusion": "flat"}},
+    # The overdue graft-tune chip-window row (ISSUE 12 / ROADMAP item 1):
+    # everything PRs 7-10 built, on in one config — fused Pallas
+    # quantize-and-pack (4-bit nibbles, 2 codes/byte) feeding the bucketed
+    # overlap executor over the hop-requant ring, at the amortizing batch.
+    # The committed TPU captures predate all of it (the sweep's qsgd rows
+    # are staged, unbucketed, quantum_num=64); this row plus the hier rows
+    # above are the `--tuned` family, so refreshing the evidence at the
+    # next tunnel window is one command: `python bench_all.py --tuned`.
+    # tpu_only for the same reason as qsgd_pallas: interpret-mode Pallas
+    # off-chip is a per-element emulation.
+    {"name": "qsgd4_packed_bucketed_pallas_bs256", "per_device_bs": 256,
+     "tpu_only": True,
+     "note": "graft-tune row family: fused quantize-pack kernel + "
+             "bucketed executor + hop-requant ring",
+     "params": {"compressor": "qsgd", "quantum_num": 7,
+                "use_pallas": True, "memory": "none",
+                "communicator": "ring", "fusion": 1024}},
+    # Its staged twin keeps the kernel ablation measurable (and gives the
+    # CPU smoke a runnable row of the same wire format + executor).
+    {"name": "qsgd4_packed_bucketed_bs256", "per_device_bs": 256,
+     "params": {"compressor": "qsgd", "quantum_num": 7,
+                "use_pallas": False, "memory": "none",
+                "communicator": "ring", "fusion": 1024}},
     # qsgd vs qsgd_pallas: THE evidence gate for flipping QSGD's
     # use_pallas default (VERDICT r3 item 5, two rounds dark).
     # use_pallas pinned False: this row is the STAGED side of the
@@ -280,6 +303,25 @@ CONFIGS = [
                                           "fusion": 64 * 2**20}},
 ]
 
+# The graft-tune evidence family (ISSUE 12): the dense anchor + headline
+# pair plus the rows the committed captures are missing — hier at the
+# projection topology and the packed+bucketed+pallas qsgd4 row. One
+# command refreshes them all: `python bench_all.py --tuned`.
+TUNED_ROW_NAMES = ("none", "topk1pct", "topk1pct_hier_bs256", "qsgd_hier",
+                   "none_hier", "qsgd4_packed_bucketed_pallas_bs256",
+                   "qsgd4_packed_bucketed_bs256")
+
+
+def active_configs():
+    """The sweep's config list, honoring the --tuned selection (carried
+    to the worker subprocess via GRACE_BENCH_TUNED — orchestrate() spawns
+    workers with an inherited environment). configs[0] must stay the
+    dense-recipe anchor in both modes (bench_configs' baseline contract)."""
+    if os.environ.get("GRACE_BENCH_TUNED"):
+        return [c for c in CONFIGS if c["name"] in TUNED_ROW_NAMES]
+    return list(CONFIGS)
+
+
 # Per-config budget: first compile dominates (~20-40s TPU, minutes on the
 # CPU fallback mesh), so size the worker timeout by sweep length.
 WORKER_TIMEOUT_S = 600 * len(CONFIGS)
@@ -305,7 +347,7 @@ def _resume_configs():
 
     Rows must match the config's current shapes (bs/hw/dtype), carry a real
     measurement (no error rows), and get "resumed": true stamped on."""
-    configs = [dict(c) for c in CONFIGS]
+    configs = [dict(c) for c in active_configs()]
     explicit = os.environ.get("GRACE_BENCH_RESUME")
     since = os.environ.get("GRACE_BENCH_RESUME_SINCE")
     if not (explicit or since):
@@ -370,7 +412,7 @@ def _worker(platform: str) -> None:
     elif os.environ.get("GRACE_BENCH_RESUME"):
         configs, evidence_path = _resume_configs(), None
     else:
-        configs, evidence_path = [dict(c) for c in CONFIGS], None
+        configs, evidence_path = [dict(c) for c in active_configs()], None
     emit = bench.progressive_emit(
         lambda r: print(json.dumps(r), flush=True),
         n_expected=len(configs),
@@ -392,7 +434,7 @@ def main() -> None:
 
     def parse(out, stages):
         rows = bench._json_lines(out, "config")
-        if len(rows) != len(CONFIGS):
+        if len(rows) != len(active_configs()):
             return None
         for r in rows:
             if stages:
@@ -415,6 +457,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--tuned" in sys.argv:
+        # One-command graft-tune evidence refresh: restrict the sweep to
+        # the tuned row family. Carried via env so the orchestrator's
+        # worker subprocesses (and their retries) inherit the selection.
+        os.environ["GRACE_BENCH_TUNED"] = "1"
+        sys.argv = [a for a in sys.argv if a != "--tuned"]
     if len(sys.argv) > 2 and sys.argv[1] == "--_worker":
         _worker(sys.argv[2])
     else:

@@ -275,6 +275,20 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
          guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
 ]
 
+# -- tuner-generated variants (ISSUE 12) -----------------------------------
+# graft-tune's candidate generator crosses codec/communicator/fusion knobs
+# the hand-written registry left uncovered (bucketed executor OVER the
+# two-level hier schedule; packed 4-bit wire through hier's hop AND
+# slice-boundary requant points). Registering them here means
+# `graft_lint --all-configs` audits everything the tuner can emit — the
+# tuner consumes this registry, so a variant it may shortlist is never a
+# lint blind spot. Entries live in grace_tpu.tuning.candidates (lazy
+# analysis imports there keep this append cycle-free).
+from grace_tpu.tuning.candidates import variant_audit_entries  # noqa: E402
+
+AUDIT_CONFIGS.extend(
+    _cfg(name, params) for name, params, _why in variant_audit_entries())
+
 
 def build_grace(entry: Dict[str, Any]):
     """The Grace bundle for one registry entry."""

@@ -172,10 +172,12 @@ class Allreduce(Communicator):
 
     def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
                           world: int, vote: bool = False) -> int:
+        # max(0, W-1): the tuner enumerates degenerate meshes (W=0/1 single
+        # rank, no exchange) and a negative byte price would rank them best.
         if vote:
             # psum of dense ±1 votes in bf16 (2 bytes), ring: 2·(W-1)/W·n·2
-            return 2 * 2 * n_elems * (world - 1) // max(1, world)
-        return 2 * payload_nbytes * (world - 1) // max(1, world)
+            return 2 * 2 * n_elems * max(0, world - 1) // max(1, world)
+        return 2 * payload_nbytes * max(0, world - 1) // max(1, world)
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:
@@ -279,7 +281,7 @@ class SignAllreduce(Communicator):
 
     def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
                           world: int, vote: bool = False) -> int:
-        return 2 * 2 * n_elems * (world - 1) // max(1, world)
+        return 2 * 2 * n_elems * max(0, world - 1) // max(1, world)
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:
@@ -467,7 +469,7 @@ class TwoShotAllreduce(Communicator):
     def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
                           world: int, vote: bool = False) -> int:
         # stage-1 all_to_all + stage-2 all_gather, each ~payload_b·(W-1)/W
-        return 2 * payload_nbytes * (world - 1) // max(1, world)
+        return 2 * payload_nbytes * max(0, world - 1) // max(1, world)
 
     def step(self, x: jax.Array, mem_state, comp_state,
              memory, compressor: Compressor, rng: jax.Array):
@@ -611,7 +613,7 @@ class RingAllreduce(Communicator):
                           world: int, vote: bool = False) -> int:
         # (W-1) reduce-scatter hop payloads + (W-1) gathered shard
         # payloads, each ~payload/W: ≈ 2·payload·(W-1)/W, flat in W.
-        return 2 * payload_nbytes * (world - 1) // max(1, world)
+        return 2 * payload_nbytes * max(0, world - 1) // max(1, world)
 
     def step(self, x: jax.Array, mem_state, comp_state,
              memory, compressor: Compressor, rng: jax.Array):

@@ -1,0 +1,215 @@
+"""Candidate enumeration: the audited registry plus tuner-generated variants.
+
+The tuner does not invent configs from thin air — its search space is the
+same (codec, communicator, fusion, pallas, precision) matrix the rest of
+the repo already enforces:
+
+* **registry candidates** come verbatim from the static auditor's
+  ``AUDIT_CONFIGS`` (update-mode entries only; resilience/observability variants are
+  orthogonal to the selection problem and the escape cond makes "the"
+  wire cost bimodal, so escape/telemetry/watch/consensus entries are
+  skipped, as is the no-exchange ``identity`` entry — a zero-byte price
+  would win every ranking while exchanging nothing);
+* **generated variants** cross the measured winning families with the
+  knobs a topology turn makes relevant — the hierarchical communicator at
+  the target slice width, the bucketed overlap executor's ``fusion=1024``,
+  the packed qsgd4 wire format, and its Pallas fused-kernel twin
+  (``tpu_only``: interpret mode off-chip is a per-element emulation).
+
+Legality is decided by the SAME capability gates the communicators raise
+at build/trace time (``summable_payload`` / ``supports_hop_requant`` /
+statelessness / vote routing / world-divisibility) — re-stated here as a
+cheap static predicate so an illegal combo is recorded in the prune
+funnel with the communicator's own rationale instead of surfacing as a
+mid-measurement ``TypeError``. ``tests/test_tuning.py`` pins that every
+gate here agrees with the runtime one it mirrors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from grace_tpu.tuning.cost import TuneTopology
+
+__all__ = ["Candidate", "enumerate_candidates", "candidate_legal",
+           "variant_audit_entries"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One (codec, communicator, fusion, pallas, precision) combination."""
+
+    name: str
+    params: Dict[str, Any]
+    source: str = "registry"        # "registry" | "generated"
+    tpu_only: bool = False          # skip in off-chip measurement
+
+    def build(self):
+        from grace_tpu.helper import grace_from_params
+        return grace_from_params(dict(self.params))
+
+
+# Params keys that select resilience/observability machinery rather than
+# the exchange itself — entries carrying them are not selection candidates.
+_NON_SELECTION_KEYS = ("escape", "telemetry", "watch", "consensus")
+
+
+def registry_candidates() -> List[Candidate]:
+    from grace_tpu.analysis.configs import AUDIT_CONFIGS
+
+    out = []
+    for e in AUDIT_CONFIGS:
+        if e.get("mode", "update") != "update":
+            continue
+        p = dict(e["params"])
+        if any(k in p for k in _NON_SELECTION_KEYS):
+            continue
+        if p.get("communicator") in ("identity", "none"):
+            continue
+        out.append(Candidate(name=e["name"], params=p, source="registry",
+                             tpu_only=bool(p.get("use_pallas") is True)))
+    return out
+
+
+def generated_variants(spec: TuneTopology) -> List[Candidate]:
+    """Deterministic topology-aware variants beyond the registry.
+
+    Only generated for knobs the registry leaves uncovered at this target:
+    hier at the *target* slice width (the registry pins slice_size=4 for
+    the world-8 audit mesh), the bucketed executor over the small-mesh
+    winners, and the packed-qsgd4 Pallas twin for the chip window.
+    """
+    topk = {"compressor": "topk", "compress_ratio": 0.01,
+            "topk_algorithm": "chunk", "memory": "residual"}
+    qsgd4 = {"compressor": "qsgd", "quantum_num": 7, "use_pallas": False,
+             "memory": "none"}
+    out = [
+        Candidate("tune-topk1pct-allgather-bucketed",
+                  {**topk, "communicator": "allgather", "fusion": 1024},
+                  source="generated"),
+        Candidate("tune-topk1pct-ring-bucketed",
+                  {**topk, "communicator": "ring", "fusion": 1024},
+                  source="generated"),
+        Candidate("tune-qsgd4-ring-packed-bucketed",
+                  {**qsgd4, "communicator": "ring", "fusion": 1024},
+                  source="generated"),
+        Candidate("tune-qsgd4-ring-packed-bucketed-pallas",
+                  {**qsgd4, "use_pallas": True, "communicator": "ring",
+                   "fusion": 1024},
+                  source="generated", tpu_only=True),
+    ]
+    s = spec.slice_size
+    if s is not None and spec.world > s:
+        out += [
+            Candidate(f"tune-topk1pct-hier{s}",
+                      {**topk, "communicator": "hier", "slice_size": s,
+                       "fusion": "flat"}, source="generated"),
+            Candidate(f"tune-topk1pct-hier{s}-bucketed",
+                      {**topk, "communicator": "hier", "slice_size": s,
+                       "fusion": 1024}, source="generated"),
+            Candidate(f"tune-qsgd4-hier{s}-packed",
+                      {**qsgd4, "communicator": "hier", "slice_size": s,
+                       "fusion": "flat"}, source="generated"),
+        ]
+    return out
+
+
+def enumerate_candidates(spec: TuneTopology) -> List[Candidate]:
+    """Registry + generated, deduped by name (registry wins — a generated
+    variant colliding with a registered entry IS that entry)."""
+    cands = registry_candidates()
+    seen = {c.name for c in cands}
+    for c in generated_variants(spec):
+        if c.name not in seen:
+            cands.append(c)
+            seen.add(c.name)
+    return cands
+
+
+def _compressor_stateful(compressor) -> bool:
+    """Whether the codec carries cross-step per-leaf state (Signum
+    momentum, PowerSGD Q) — the shard-parallel communicators reject those
+    at step time because chunked shards give the state no meaning."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        s = jax.eval_shape(compressor.init_state,
+                           jax.ShapeDtypeStruct((8,), jnp.float32))
+    except Exception:       # in-compress collectives etc.: assume stateful
+        return True
+    return s is not None
+
+
+def candidate_legal(candidate: Candidate, spec: TuneTopology
+                    ) -> Tuple[bool, Optional[str], Any]:
+    """(legal, reason, grace) — the static mirror of the communicators'
+    build/step-time gates, evaluated at the TARGET world. ``grace`` is the
+    built bundle when construction succeeded (legal or not), else None."""
+    from grace_tpu import comm
+
+    try:
+        grace = candidate.build()
+    except (TypeError, ValueError) as e:
+        return False, f"does not build: {type(e).__name__}: {e}", None
+    comp, cm = grace.compressor, grace.communicator
+    w = spec.world
+    vote = bool(getattr(comp, "vote_aggregate", False))
+    summable = bool(getattr(comp, "summable_payload", False))
+    requant = bool(getattr(comp, "supports_hop_requant", False))
+
+    if isinstance(cm, comm.SignAllreduce) and not vote:
+        return False, ("SignAllreduce requires vote_aggregate=True "
+                       f"({type(comp).__name__} declares False) — the "
+                       "re-sign would drop its aggregate's scaling"), grace
+    if type(cm) is comm.Allreduce and not (vote or summable):
+        return False, ("Allreduce requires summable_payload=True "
+                       f"({type(comp).__name__} declares False) — per-rank "
+                       "payloads decode differently"), grace
+    if isinstance(cm, (comm.TwoShotAllreduce, comm.RingAllreduce,
+                       comm.HierarchicalAllreduce)):
+        if _compressor_stateful(comp):
+            return False, (f"{type(cm).__name__} requires a stateless "
+                           f"compressor; {type(comp).__name__} carries "
+                           "cross-step state with no per-chunk meaning"), \
+                grace
+    if isinstance(cm, (comm.RingAllreduce, comm.HierarchicalAllreduce)) \
+            and not (summable or requant):
+        return False, (f"{type(cm).__name__} keeps the payload compressed "
+                       "on every hop, which needs summable_payload or "
+                       f"supports_hop_requant; {type(comp).__name__} "
+                       "declares neither"), grace
+    if isinstance(cm, comm.HierarchicalAllreduce):
+        s = cm.slice_size
+        if s is not None and w > s and w % s:
+            return False, (f"HierarchicalAllreduce(slice_size={s}) does "
+                           f"not divide world {w} — the two-level schedule "
+                           "needs whole slices"), grace
+    return True, None, grace
+
+
+def variant_audit_entries() -> List[Tuple[str, Dict[str, Any], str]]:
+    """The tuner-generated variants pinned into the static auditor's
+    registry (``analysis.configs.AUDIT_CONFIGS`` appends these), so
+    ``graft_lint --all-configs`` covers what the tuner can emit:
+    (name, params, comment) triples. slice_size=4 puts a real boundary
+    inside the 8-way audit mesh, same as the registered hier family.
+
+    New coverage, not duplicates: the bucketed executor OVER the two-level
+    hierarchical schedule (per-bucket intra-slice rings + grouped
+    cross-slice gathers in one trace), and the 4-bit packed wire format
+    requantized at hier's hop AND slice-boundary re-encode points.
+    """
+    topk = {"compressor": "topk", "compress_ratio": 0.01,
+            "topk_algorithm": "chunk", "memory": "residual",
+            "communicator": "hier", "slice_size": 4}
+    return [
+        ("tune-topk1pct-hier-bucketed", {**topk, "fusion": 1024},
+         "bucketed executor x two-level hier schedule"),
+        ("tune-qsgd4-hier-packed",
+         {"compressor": "qsgd", "quantum_num": 7, "use_pallas": False,
+          "memory": "none", "communicator": "hier", "slice_size": 4,
+          "fusion": "flat"},
+         "packed 4-bit wire over hier hop+boundary requant"),
+    ]

@@ -1,0 +1,178 @@
+"""The tuner's documented cost model: wire-dominated step-time projection.
+
+One pricing rule, stated once and stamped into every ``TUNE_LAST.json``:
+
+    projected_step = base_compute_step
+                     + ici_bytes / ICI_BW + dcn_bytes / DCN_BW
+
+where ``(ici_bytes, dcn_bytes)`` is :meth:`Communicator.recv_link_bytes`
+under the *target* :class:`~grace_tpu.core.Topology` — the same shared
+per-link wire model the bench projections, the telemetry ring and the
+static auditor's wire-reconciliation pass already agree on — and the
+bandwidth constants are ``bench.PROJECTION_MODEL``'s public per-chip
+numbers (ICI ~90 GB/s, DCN ~25 GB/s), imported, not duplicated, so the
+tuner and the bench can never price the same bytes differently.
+
+Why ICI and DCN legs are priced separately: a flat communicator's
+critical-path rank receives every pipelined chunk over the slice-boundary
+link the moment the axis crosses slices, so its whole bill lands on the
+~3.6×-slower DCN; the hierarchical communicator's mixed split keeps the
+2·k·(S−1)/S intra-slice legs on ICI and ships only (K−1)·k/S across DCN.
+Collapsing the two legs into one bandwidth erases exactly the distinction
+the topology-aware selection exists to exploit (ScaleCom's W-dependent
+topk degradation, EQuARX's per-topology tuning — PAPERS.md).
+
+Model limits (recorded in the evidence, enforced by the measured stage):
+
+* **wire-dominated**: the static stage prices every candidate at the SAME
+  base compute step — codec compute cost (topk selection, qsgd quantize,
+  pallas fusion) is deliberately NOT modeled, because the repo's own
+  bench history shows it is unpredictable from first principles (the
+  staged qsgd path measured 42% slower than the kernel; chunk vs exact
+  top-k is a 2× swing). That is what the measured shortlist is for.
+* **no overlap**: same NO-OVERLAP upper bound as ``PROJECTION_MODEL``;
+  the flow pass-5 static overlap bound rides along per candidate as the
+  honesty reference for the measured sandwich, not as a discount factor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Any, Dict, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _bench_module():
+    """The repo-root ``bench`` module (stdlib-only at import time). The
+    tuner lives inside the package, so add the checkout root when running
+    from an installed layout."""
+    try:
+        import bench
+    except ImportError:
+        sys.path.insert(0, ROOT)
+        import bench
+    if not hasattr(bench, "PROJECTION_MODEL"):
+        raise ImportError(
+            "a different top-level module shadows the repo's bench.py — "
+            "the tuner needs bench.PROJECTION_MODEL's bandwidth constants")
+    return bench
+
+
+def projection_constants():
+    """(ici_bytes_per_s, dcn_bytes_per_s, projection_model_doc) — the ONE
+    set of bandwidth assumptions, owned by bench.py."""
+    bench = _bench_module()
+    return (bench.ICI_RING_BYTES_PER_S, bench.DCN_BYTES_PER_S,
+            bench.PROJECTION_MODEL)
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneTopology:
+    """The tuner's target mesh: world size + ICI slice width.
+
+    ``slice_size=None`` is a single ICI slice of any width (the regime
+    every committed single-chip measurement ran in); ``W=256, slice8`` is
+    the xslice projection topology. Parsed from the CLI's ``W`` /
+    ``W,slice_size`` spelling.
+    """
+
+    world: int
+    slice_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.world < 1:
+            raise ValueError(f"world must be >= 1; got {self.world}")
+        if self.slice_size is not None and self.slice_size < 1:
+            raise ValueError(
+                f"slice_size must be >= 1 or None; got {self.slice_size}")
+
+    @classmethod
+    def parse(cls, text: str) -> "TuneTopology":
+        parts = [p.strip() for p in str(text).split(",") if p.strip()]
+        if not parts or len(parts) > 2:
+            raise ValueError(
+                f"topology spec {text!r} is not 'W' or 'W,slice_size'")
+        world = int(parts[0])
+        slice_size = int(parts[1]) if len(parts) == 2 else None
+        return cls(world=world, slice_size=slice_size)
+
+    def core_topology(self):
+        from grace_tpu.core import Topology
+        return Topology(slice_size=self.slice_size)
+
+    @property
+    def label(self) -> str:
+        if self.slice_size is None:
+            return f"W{self.world}"
+        return f"W{self.world}/slice{self.slice_size}"
+
+
+def dense_bytes(model_structs) -> int:
+    """Dense gradient bytes of a param pytree (structs or arrays)."""
+    import jax
+    import numpy as np
+
+    return int(sum(
+        int(np.prod(l.shape, dtype=np.int64)) * np.dtype(l.dtype).itemsize
+        for l in jax.tree_util.tree_leaves(model_structs)))
+
+
+def n_elements(model_structs) -> int:
+    import jax
+    import numpy as np
+
+    return int(sum(int(np.prod(l.shape, dtype=np.int64))
+                   for l in jax.tree_util.tree_leaves(model_structs)))
+
+
+def price_candidate(grace, model_structs, spec: TuneTopology, *,
+                    base_step_s: float = 0.0,
+                    dense_step_s: Optional[float] = None) -> Dict[str, Any]:
+    """One candidate's static price under the target topology.
+
+    ``base_step_s`` is the compute-side step time assumed for EVERY
+    candidate (0.0 = pure wire ranking; the measured stage replaces it
+    with each candidate's own timed step); ``dense_step_s`` defaults to
+    the same value so the speedup ratio stays like-for-like. Dense rides
+    a ring allreduce priced through the identical shared model
+    (``bench.project_multichip``'s convention).
+    """
+    from grace_tpu.comm import Allreduce
+    from grace_tpu.utils import wire_report
+
+    ici_bw, dcn_bw, _ = projection_constants()
+    dense_step_s = base_step_s if dense_step_s is None else dense_step_s
+    rep = wire_report(grace.compressor, model_structs)
+    n = n_elements(model_structs)
+    dense_b = dense_bytes(model_structs)
+    vote = bool(getattr(grace.compressor, "vote_aggregate", False))
+    topo = spec.core_topology()
+    link = grace.communicator.recv_link_bytes(
+        rep.wire_bytes, n, spec.world, topology=topo, vote=vote)
+    dense_link = Allreduce(
+        axis_name=grace.communicator.axis_name).recv_link_bytes(
+            dense_b, n, spec.world, topology=topo)
+
+    def wire_s(lb):
+        return lb.ici / ici_bw + lb.dcn / dcn_bw
+
+    step_s = base_step_s + wire_s(link)
+    d_step_s = dense_step_s + wire_s(dense_link)
+    return {
+        "payload_bytes": int(rep.wire_bytes),
+        "wire_ratio": round(rep.wire_bytes / max(1, dense_b), 6),
+        "ici_bytes": int(link.ici),
+        "dcn_bytes": int(link.dcn),
+        "wire_ms": round(wire_s(link) * 1e3, 9),
+        "dense_ici_bytes": int(dense_link.ici),
+        "dense_dcn_bytes": int(dense_link.dcn),
+        "dense_wire_ms": round(wire_s(dense_link) * 1e3, 9),
+        "projected_step_ms": round(step_s * 1e3, 9),
+        "dense_projected_step_ms": round(d_step_s * 1e3, 9),
+        "predicted_speedup_vs_dense": round(d_step_s / step_s, 4)
+        if step_s > 0 else None,
+    }

@@ -1,0 +1,293 @@
+"""Static pruning: capability gate → numeric gate → wire price → flow audit.
+
+Every candidate leaves this stage with an auditable funnel record — which
+gate it died at and why, or its full static price — so a shortlist is an
+*argued* selection, never vibes. The stages, in cost order (cheapest
+rejections first):
+
+1. **capability** — the communicators' own build/step-time compatibility
+   gates, evaluated statically (:func:`..candidates.candidate_legal`).
+2. **numeric** — payload-space summation and vote exactness at the TARGET
+   world, from the same constants the numeric-safety pass and the runtime
+   vote guard share (``flow.safe_sum_terms``, ``comm.vote_exact_max_world``)
+   — a W=4096 fp16 hop-sum dies here, statically, before anything traces.
+3. **degradation** — cascaded-requant chain length at the target world
+   (:data:`MAX_REQUANT_CHAIN`): the ScaleCom-documented reason the winner
+   is scale-dependent — a flat hop-requant ring re-encodes W−1 times, so
+   on raw bytes it outprices the hierarchical schedule at any W, while its
+   compounding re-selection error (linear in hop count, pinned by the
+   PR-4 hop-error bound test and uncovered by error feedback past stage 1)
+   makes it unusable there. Without this gate the byte-only cost model
+   would pick exactly the config the paper trail says degrades.
+4. **price** — the wire-dominated step-time projection
+   (:mod:`..cost`) under the target topology; every survivor is ranked.
+5. **flow** — the top of the ranking is traced on the abstract audit mesh
+   and run through graft-flow's pass 5/6/7 (overlap schedulability bound,
+   numeric-range safety over the traced graph, HBM footprint); error
+   findings reject, and the static overlap bound rides into the record as
+   the sandwich reference the measured stage is judged against.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from grace_tpu.tuning.candidates import Candidate, candidate_legal
+from grace_tpu.tuning.cost import TuneTopology, price_candidate
+
+__all__ = ["degradation_verdict", "numeric_verdict",
+           "requant_chain_length", "static_prune"]
+
+# How many ranked survivors get the (comparatively expensive) abstract-mesh
+# trace + flow passes, beyond the shortlist itself: the shortlist must be
+# fully audited, plus a small margin so a flow rejection still leaves a
+# full shortlist.
+FLOW_AUDIT_MARGIN = 2
+
+# Longest tolerated cascaded-requant chain (decompress → accumulate →
+# re-encode repetitions a gradient survives on its way to aggregation).
+# Per-hop requant error is ~linear in chain length (the committed
+# 1-hop-vs-7-hop qsgd bound test) and error feedback covers only the
+# stage-1 encode, so the compounding loss at W−1 = hundreds of hops is the
+# topk-at-large-W degradation ScaleCom documents. 32 tolerates every
+# intra-slice schedule a real machine has (S ≤ 32 hops; hier's boundary
+# adds ONE more regardless of K) while rejecting flat hop-requant rings at
+# pod scale — candidates near the bound still reach the measured stage,
+# where convergence floors have the final say.
+MAX_REQUANT_CHAIN = 32
+
+
+def _payload_float_dtypes(compressor) -> List[Any]:
+    """Float dtypes of the codec's wire payload (shape-traced; codecs whose
+    compress needs a bound mesh axis — PowerSGD — are assumed float32,
+    which is safe: f32's term budget is ~10^36)."""
+    import jax
+    import jax.numpy as jnp
+
+    def encode(x):
+        rng = jax.random.key(0)
+        payload, _, _ = compressor.compress(x, compressor.init_state(x), rng)
+        return payload
+
+    try:
+        payload = jax.eval_shape(
+            encode, jax.ShapeDtypeStruct((64,), jnp.float32))
+    except Exception:
+        return [jnp.dtype("float32")]
+    return [l.dtype for l in jax.tree_util.tree_leaves(payload)
+            if jnp.issubdtype(l.dtype, jnp.floating)]
+
+
+def numeric_verdict(grace, spec: TuneTopology) -> Optional[str]:
+    """Why this candidate is numerically unsafe at the target world, or
+    None. Static twin of flow pass 6's range analysis, specialized to the
+    two world-scaling accumulations a communicator can take off-trace:
+
+    * payload-space summation (Allreduce's psum, Ring/Hier's exact hop
+      path for ``summable_payload`` codecs) accumulates up to W
+      unit-magnitude terms in the payload dtype —
+      ``flow.safe_sum_terms(dtype)`` is the cliff (fp16 saturates at
+      ~255 terms; bf16/f32 never at any real W);
+    * ±1 vote psums stay integer-exact only to
+      ``comm.vote_exact_max_world(vote_dtype)`` (bf16: 256) — the same
+      bound the runtime guard raises past on a live mesh.
+
+    Requant paths accumulate decompressed partials in dense f32 and are
+    exempt, exactly as pass 6 treats them.
+    """
+    from grace_tpu import comm
+    from grace_tpu.analysis import flow
+
+    comp, cm = grace.compressor, grace.communicator
+    w = spec.world
+    vote = bool(getattr(comp, "vote_aggregate", False))
+    if vote and isinstance(cm, (comm.Allreduce, comm.SignAllreduce)):
+        vd = getattr(cm, "vote_dtype", "bfloat16")
+        bound = comm.vote_exact_max_world(vd)
+        if w > bound:
+            return (f"±1 vote psum in {vd} is integer-exact only to "
+                    f"W={bound} (vote_exact_max_world); W={w} ties would "
+                    "silently round — the runtime vote guard raises here")
+    summable = bool(getattr(comp, "summable_payload", False))
+    sums_payload = (isinstance(cm, (comm.Allreduce, comm.RingAllreduce,
+                                    comm.HierarchicalAllreduce))
+                    and summable and not vote)
+    if sums_payload:
+        for dt in _payload_float_dtypes(comp):
+            terms = flow.safe_sum_terms(dt)
+            if terms is not None and w > terms:
+                return (f"payload-space sum of W={w} {dt} terms exceeds "
+                        f"safe_sum_terms({dt})={terms} "
+                        f"(finfo.max/{int(flow.NUMERIC_UNIT_MAG)} unit "
+                        "magnitudes) — silent inf, the flow pass-6 cliff")
+    return None
+
+
+def requant_chain_length(grace, spec: TuneTopology) -> int:
+    """How many times this candidate re-encodes a partial sum on the way
+    to aggregation at the target world. 0 for payload-space-exact and
+    gather/vote schedules; W−1 for a flat hop-requant ring; S−1 intra-slice
+    hops + 1 slice-boundary re-encode for hier's requant path (the design
+    point: one boundary requant regardless of K); 1 for two-shot's stage-2
+    re-compression."""
+    from grace_tpu import comm
+
+    comp, cm = grace.compressor, grace.communicator
+    summable = bool(getattr(comp, "summable_payload", False))
+    requant = bool(getattr(comp, "supports_hop_requant", False))
+    w = spec.world
+    if summable or not requant:
+        if isinstance(cm, comm.TwoShotAllreduce) and not summable:
+            return 1
+        return 0
+    if isinstance(cm, comm.HierarchicalAllreduce):
+        s = cm.slice_size
+        if s is None or w <= s:
+            return max(0, w - 1)            # collapses to the flat ring
+        return (s - 1) + 1
+    if isinstance(cm, comm.RingAllreduce):
+        return max(0, w - 1)
+    if isinstance(cm, comm.TwoShotAllreduce):
+        return 1
+    return 0
+
+
+def degradation_verdict(grace, spec: TuneTopology) -> Optional[str]:
+    """Why this candidate's compression quality degrades at the target
+    scale, or None — the ScaleCom gate (see :data:`MAX_REQUANT_CHAIN`)."""
+    chain = requant_chain_length(grace, spec)
+    if chain > MAX_REQUANT_CHAIN:
+        return (f"cascaded requant chain of {chain} re-encodes at W="
+                f"{spec.world} exceeds MAX_REQUANT_CHAIN="
+                f"{MAX_REQUANT_CHAIN}: per-hop requant error is ~linear "
+                "in chain length and uncovered by error feedback past "
+                "stage 1 — the topk-family large-W degradation ScaleCom "
+                "documents; use a hierarchical or two-shot schedule there")
+    return None
+
+
+def _flow_audit(grace, name: str, audit_world: int) -> Dict[str, Any]:
+    """Trace one survivor on the abstract audit mesh and run the three
+    graft-flow passes. Returns {'overlap_bound', 'errors': [...]} —
+    errors reject the candidate."""
+    from grace_tpu.analysis.flow import (overlap_summary,
+                                         pass_memory_footprint,
+                                         pass_numeric_safety,
+                                         pass_overlap_schedulability)
+    from grace_tpu.analysis.trace import trace_update
+
+    traced = trace_update(grace, world=audit_world, name=name,
+                          meta={"grace": grace})
+    findings = (pass_overlap_schedulability(traced)
+                + pass_numeric_safety(traced)
+                + pass_memory_footprint(traced))
+    s = overlap_summary(traced)
+    bound = s["static_overlap_bound"]
+    return {
+        "overlap_bound": round(bound, 6) if bound is not None else None,
+        "independent_chains": int(s["independent_chains"]),
+        "errors": [f"{f.pass_name}: {f.message}" for f in findings
+                   if f.severity == "error"],
+    }
+
+
+def static_prune(candidates: List[Candidate], spec: TuneTopology,
+                 model_structs, *, audit_world: int = 8,
+                 shortlist_n: int = 3) -> Dict[str, Any]:
+    """The full static funnel for one target topology.
+
+    Returns ``{"topology", "funnel", "ranking", "shortlist"}`` where
+    ``funnel`` holds one record per candidate in enumeration order (stage
+    reached, verdict, reason or price), ``ranking`` the priced survivors
+    sorted by projected step time, and ``shortlist`` the top
+    ``shortlist_n`` names that also survived the flow audit.
+    """
+    funnel: List[Dict[str, Any]] = []
+    by_name: Dict[str, Dict[str, Any]] = {}
+    graces: Dict[str, Any] = {}
+    for c in candidates:
+        rec: Dict[str, Any] = {"candidate": c.name, "source": c.source,
+                               "params": dict(c.params)}
+        if c.tpu_only:
+            rec["tpu_only"] = True
+        funnel.append(rec)
+        by_name[c.name] = rec
+        legal, reason, grace = candidate_legal(c, spec)
+        if not legal:
+            rec.update(stage="capability", verdict="rejected",
+                       reason=reason)
+            continue
+        graces[c.name] = grace
+        reason = numeric_verdict(grace, spec)
+        if reason:
+            rec.update(stage="numeric", verdict="rejected", reason=reason)
+            continue
+        reason = degradation_verdict(grace, spec)
+        if reason:
+            rec.update(stage="degradation", verdict="rejected",
+                       reason=reason)
+            continue
+        try:
+            price = price_candidate(grace, model_structs, spec)
+        except Exception as e:                           # noqa: BLE001
+            rec.update(stage="price", verdict="rejected",
+                       reason=f"unpriceable: {type(e).__name__}: {e}")
+            continue
+        rec.update(stage="price", verdict="priced", predicted=price)
+
+    ranked = sorted(
+        (r for r in funnel if r.get("verdict") == "priced"),
+        key=lambda r: (r["predicted"]["projected_step_ms"], r["candidate"]))
+    audit_n = shortlist_n + FLOW_AUDIT_MARGIN
+    shortlist: List[str] = []
+    for r in ranked:
+        if len(shortlist) >= shortlist_n or audit_n <= 0:
+            break
+        audit_n -= 1
+        name = r["candidate"]
+        try:
+            audit = _flow_audit(graces[name], name, audit_world)
+        except Exception as e:                           # noqa: BLE001
+            r.update(stage="flow", verdict="rejected",
+                     reason=f"failed to trace on the audit mesh: "
+                            f"{type(e).__name__}: {e}")
+            continue
+        r["flow"] = {k: v for k, v in audit.items() if k != "errors"}
+        r["flow"]["audit_world"] = audit_world
+        if audit["errors"]:
+            r.update(stage="flow", verdict="rejected",
+                     reason="; ".join(audit["errors"]))
+            continue
+        r.update(stage="flow", verdict="shortlisted")
+        shortlist.append(name)
+
+    return {
+        "topology": {"world": spec.world, "slice_size": spec.slice_size,
+                     "label": spec.label},
+        "funnel": funnel,
+        "ranking": [{"candidate": r["candidate"],
+                     "projected_step_ms":
+                         r["predicted"]["projected_step_ms"],
+                     "predicted_speedup_vs_dense":
+                         r["predicted"]["predicted_speedup_vs_dense"],
+                     "ici_bytes": r["predicted"]["ici_bytes"],
+                     "dcn_bytes": r["predicted"]["dcn_bytes"],
+                     "verdict": r["verdict"]}
+                    for r in ranked],
+        "shortlist": shortlist,
+        "counts": {
+            "enumerated": len(funnel),
+            "capability_rejected": sum(
+                1 for r in funnel if r.get("stage") == "capability"),
+            "numeric_rejected": sum(
+                1 for r in funnel
+                if r.get("stage") == "numeric"),
+            "degradation_rejected": sum(
+                1 for r in funnel if r.get("stage") == "degradation"),
+            "priced": len(ranked),
+            "flow_rejected": sum(
+                1 for r in funnel if r.get("stage") == "flow"
+                and r.get("verdict") == "rejected"),
+            "shortlisted": len(shortlist),
+        },
+    }

@@ -258,8 +258,37 @@ def test_recv_wire_bytes_degenerate_worlds(cls):
         assert two == 0
     else:
         assert 0 < two <= 2 * payload + 4 * n   # ≤ dense-ish upper bound
-    # W=0 is nonsensical but must not crash the telemetry path (max(1, w))
-    assert c.recv_wire_bytes(payload, n, 0) <= 0 or True
+    # W=0 is nonsensical but must price to 0, not negative: the tuner
+    # enumerates degenerate meshes, and a negative byte price would rank
+    # the broken config best (the ring-family 2·p·(W-1)/W formulas used
+    # to return -2p here before the max(0, W-1) clamp).
+    for vote in (False, True):
+        assert c.recv_wire_bytes(payload, n, 0, vote=vote) == 0
+        lb = c.recv_link_bytes(payload, n, 0, vote=vote)
+        assert lb.ici == lb.dcn == 0
+
+
+def test_hier_slice1_degenerate_worlds():
+    """HierarchicalAllreduce(slice_size=1) — every rank its own slice, the
+    tuner's most degenerate generated mesh: W<=1 prices to 0 on both links,
+    and at W>1 the schedule is pure cross-slice exchange ((W-1)·payload
+    partials, no intra-slice hops) — all DCN once a multi-slice topology
+    says the axis crosses."""
+    from grace_tpu.core import Topology
+
+    c = comm.HierarchicalAllreduce(slice_size=1)
+    payload, n = 4096, 1024
+    for w in (0, 1):
+        for vote in (False, True):
+            assert c.recv_wire_bytes(payload, n, w, vote=vote) == 0
+            lb = c.recv_link_bytes(payload, n, w,
+                                   topology=Topology(slice_size=1),
+                                   vote=vote)
+            assert lb.ici == lb.dcn == 0
+    # W=2, slice_size=1: no intra hops (S-1 == 0), one cross-slice partial.
+    assert c.recv_wire_bytes(payload, n, 2) == payload
+    lb = c.recv_link_bytes(payload, n, 2, topology=Topology(slice_size=1))
+    assert (lb.ici, lb.dcn) == (0, payload)
 
 
 def test_ring_wire_model_monotone_in_world():

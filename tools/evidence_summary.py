@@ -17,9 +17,30 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 BEGIN, END = "<!-- evidence:begin -->", "<!-- evidence:end -->"
+
+
+def _staleness(doc):
+    """bench.evidence_staleness — the ONE stale-evidence detector, shared
+    with the bench's own last_tpu carry-along readers."""
+    import bench
+    return bench.evidence_staleness(doc)
+
+
+def _stale_parts(doc):
+    """(title_suffix, trailing_lines) for a possibly-stale evidence doc."""
+    reasons = _staleness(doc)
+    if not reasons:
+        return "", []
+    return " — ⚠ STALE — predates PRs 7–10", [
+        "", "⚠ **STALE — predates PRs 7–10**: " + "; ".join(reasons)
+        + ". The numbers above describe the pre-hier/pre-bucketed/"
+          "pre-fused-pack system; refresh the capture with "
+          "`python bench_all.py --tuned` at the next chip window."]
 
 
 def _load(name):
@@ -163,17 +184,21 @@ def build() -> str:
         cap = head.get("captured_at", "?")
         chip = head.get("chip", "?")
         partial = " (PARTIAL)" if head.get("partial") else ""
+        suffix, trailer = _stale_parts(head)
         parts += _row_table(
             head["rows"],
-            f"TPU headline ({chip}, captured {cap}){partial}")
+            f"TPU headline ({chip}, captured {cap}){partial}{suffix}")
+        parts += trailer
         parts.append("")
     sweep = _load("BENCH_ALL_TPU_LAST.json")
     if sweep and sweep.get("rows"):
         cap = sweep.get("captured_at", "?")
         partial = " (PARTIAL)" if sweep.get("partial") else ""
+        suffix, trailer = _stale_parts(sweep)
         parts += _row_table(
             sweep["rows"], f"TPU per-algorithm sweep (captured {cap})"
-            + partial)
+            + partial + suffix)
+        parts += trailer
         # Same-named rows measured under different stamped params (e.g. the
         # round-5 headline moving to per-leaf after the sweep captured the
         # fused pair) read as contradictions without a caveat.
@@ -349,6 +374,42 @@ def build() -> str:
             f"Run health (graft-watch): `graft_watch "
             f"{watch.get('artifact', '?')}` → " + ", ".join(bits) +
             f" (`WATCH_LAST.json`{', ' + when if when else ''}){note}.")
+    tune = _load("TUNE_LAST.json")
+    if isinstance(tune, dict) and tune.get("tool") == "graft_tune":
+        when = (tune.get("captured_at") or "").split("T")[0]
+        bits = []
+        for label, st in sorted((tune.get("static") or {}).items()):
+            c = st.get("counts") or {}
+            top = (st.get("ranking") or [{}])[0].get("candidate", "?")
+            bits.append(
+                f"{label}: {c.get('enumerated', '?')} enumerated → "
+                f"{c.get('capability_rejected', 0)} capability / "
+                f"{c.get('numeric_rejected', 0)} numeric / "
+                f"{c.get('degradation_rejected', 0)} degradation rejected "
+                f"→ {c.get('shortlisted', 0)} shortlisted, "
+                f"top static pick `{top}`")
+        w = tune.get("winner")
+        if w:
+            s = w.get("overlap_sandwich") or {}
+            m = w.get("measured") or {}
+            verdict = "holds" if s.get("holds") else "VIOLATED"
+            bits.append(
+                f"winner `{w.get('candidate')}` at {tune.get('target')} "
+                f"(measured step {m.get('measured_step_ms', '?')} ms, "
+                f"×{m.get('measured_speedup_vs_dense', '?')} vs dense "
+                f"same-session; measured≤static overlap sandwich "
+                f"{s.get('measured_overlap')}≤"
+                f"{s.get('static_overlap_bound')}: {verdict}) — load with "
+                f"`grace_from_params(TUNE_LAST.winner.grace_params)`")
+        elif tune.get("static_only"):
+            bits.append("static-only survey (no measured winner stamped)")
+        platform = (tune.get("provenance") or {}).get("platform")
+        note = (" — CPU-mesh pipeline evidence, not a chip capture"
+                if platform and platform != "tpu" else "")
+        parts.append("")
+        parts.append(
+            "Autotuning (graft-tune): `graft_tune` → " + "; ".join(bits)
+            + f" (`TUNE_LAST.json`{', ' + when if when else ''}){note}.")
     return "\n".join(parts).rstrip() + "\n"
 
 
