@@ -140,6 +140,24 @@ CONFIGS = [
                                      "communicator": "hier",
                                      "slice_size": 8,
                                      "fusion": "flat"}},
+    # Aggregation-homomorphic row family (ISSUE 13): shared-scale qsgd4
+    # whose integer payloads SUM on every hop and at the slice boundary —
+    # zero requant regardless of W, one decode at the schedule's end, one
+    # scalar pmax negotiation before stage 1. Pairs with qsgd_ring (the
+    # per-hop requant path this family retires: W−1 re-encodes, the
+    # PR-12 MAX_REQUANT_CHAIN degradation) and with the hier rows (same
+    # two-level schedule, boundary requant → boundary integer add). Wire
+    # is int16 (fp16-width) — the story is the quality-at-ring-cost, not
+    # the bytes: hop-count-independent compression error at ring/hier's
+    # O(k), where the tuner's funnel now prices requant-chain 0.
+    {"name": "homoqsgd4_ring_bs256", "per_device_bs": 256,
+     "params": {"compressor": "homoqsgd", "quantum_num": 7,
+                "memory": "residual", "communicator": "ring",
+                "fusion": "flat"}},
+    {"name": "homoqsgd4_hier_slice8", "per_device_bs": 256,
+     "params": {"compressor": "homoqsgd", "quantum_num": 7,
+                "memory": "residual", "communicator": "hier",
+                "slice_size": 8, "fusion": "flat"}},
     # The overdue graft-tune chip-window row (ISSUE 12 / ROADMAP item 1):
     # everything PRs 7-10 built, on in one config — fused Pallas
     # quantize-and-pack (4-bit nibbles, 2 codes/byte) feeding the bucketed
@@ -309,7 +327,11 @@ CONFIGS = [
 # command refreshes them all: `python bench_all.py --tuned`.
 TUNED_ROW_NAMES = ("none", "topk1pct", "topk1pct_hier_bs256", "qsgd_hier",
                    "none_hier", "qsgd4_packed_bucketed_pallas_bs256",
-                   "qsgd4_packed_bucketed_bs256")
+                   "qsgd4_packed_bucketed_bs256",
+                   # the homomorphic family (ISSUE 13): the zero-requant
+                   # ring/hier rows the tuner's requant-chain-0 pricing
+                   # needs measured evidence for
+                   "homoqsgd4_ring_bs256", "homoqsgd4_hier_slice8")
 
 
 def active_configs():

@@ -51,14 +51,18 @@ triad; per-algorithm hyperparameters have the reference defaults."""
 def add_grace_args(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("grace", GRACE_FLAG_DOC)
     g.add_argument("--compressor", default="none",
-                   help="none|fp16|topk|randomk|threshold|qsgd|terngrad|"
-                        "signsgd|signum|efsignsgd|onebit|natural|dgc|"
-                        "powersgd|u8bit|sketch|adaq|inceptionn")
+                   help="none|fp16|topk|randomk|threshold|qsgd|homoqsgd|"
+                        "countsketch|terngrad|signsgd|signum|efsignsgd|"
+                        "onebit|natural|dgc|powersgd|u8bit|sketch|adaq|"
+                        "inceptionn")
     g.add_argument("--memory", default="none",
                    help="none|residual|efsignsgd|dgc|powersgd")
     g.add_argument("--communicator", default="allgather",
                    help="allreduce|allgather|broadcast|sign_allreduce|"
-                        "twoshot|ring|identity")
+                        "twoshot|ring|hier|identity")
+    g.add_argument("--slice-size", type=int, default=None,
+                   help="with --communicator hier: ranks per ICI slice "
+                        "(the two-level schedule needs whole slices)")
     g.add_argument("--compress-ratio", type=float, default=0.01)
     g.add_argument("--quantum-num", type=int, default=64)
     g.add_argument("--threshold", type=float, default=0.01)
@@ -102,6 +106,8 @@ def grace_params_from_args(args) -> dict:
         "topk_algorithm": args.topk_algorithm,
         "recall_target": args.recall_target,
     }
+    if getattr(args, "slice_size", None):
+        params["slice_size"] = args.slice_size
     # Only force use_pallas when the operator explicitly asked: the flag's
     # resting default must leave each compressor's own default in charge —
     # 'auto' resolves per the measured on-chip A/Bs (TopK: staged; QSGD:

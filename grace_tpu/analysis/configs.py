@@ -145,6 +145,29 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
     _cfg("signsgd_hier", {"compressor": "signsgd", "memory": "none",
                           "communicator": "hier", "slice_size": 4,
                           "fusion": "flat"}),
+    # -- aggregation-homomorphic family (ISSUE 13): payload-algebra codecs
+    #    whose wire payloads SUM on every hop and slice boundary with zero
+    #    requant. The homoqsgd traces carry the hoisted shared-scale
+    #    negotiation (one pmax before stage 1 — a scalar collective inside
+    #    the wire model's atol, audited by wire_reconciliation like every
+    #    other traced collective), integer ppermute/gather payloads, and
+    #    ONE decode at the schedule's end; numeric_safety additionally
+    #    checks the int accumulator against payload_sum_max_world at the
+    #    audit world.
+    _cfg("homoqsgd-ring", {"compressor": "homoqsgd", "quantum_num": 7,
+                           "memory": "residual", "communicator": "ring",
+                           "fusion": "flat"}),
+    _cfg("homoqsgd-hier", {"compressor": "homoqsgd", "quantum_num": 7,
+                           "memory": "residual", "communicator": "hier",
+                           "slice_size": 4, "fusion": "flat"}),
+    # Mergeable count-sketch over the gather family: the sketch algebra's
+    # ctx (hash indices/signs) is rng-derived, so the data-free-ctx decode
+    # contract holds and the payload (rows × width f32 tables) reconciles
+    # against the gather model like any other codec.
+    _cfg("countsketch-allgather", {"compressor": "countsketch",
+                                   "compress_ratio": 0.25,
+                                   "memory": "residual",
+                                   "communicator": "allgather"}),
     # -- degenerate / fusion variants ---------------------------------------
     _cfg("none-identity", {"compressor": "none", "memory": "none",
                            "communicator": "identity"}),
@@ -261,6 +284,21 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
          {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
           "communicator": "allgather", "fusion": 1024, "escape": "fp16",
           "telemetry": True, "consensus": True},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+    # The homomorphic two-level schedule under the full resilience stack
+    # (ISSUE 13): the escape cond's compressed branch is now the hier
+    # payload-space integer summation (negotiate pmax + int ppermute hops
+    # + int cross-slice gather-sum + ONE decode) while its dense branch
+    # stays the fp16 psum — branches differ by whole schedules, legal only
+    # because the fallback predicate is replicated; the consensus audit
+    # fingerprints downstream of the homomorphic aggregate, so
+    # collective_consistency and bit_exactness must bless the zero-requant
+    # path end to end.
+    _cfg("homoqsgd-hier-guard-consensus",
+         {"compressor": "homoqsgd", "quantum_num": 7, "memory": "residual",
+          "communicator": "hier", "slice_size": 4, "fusion": "flat",
+          "escape": "fp16", "consensus": True},
          passes=_NO_WIRE, mode="train",
          guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
     # The full observability+resilience stack in one trace: watch's gated

@@ -655,6 +655,48 @@ def _packing_contract(pack_fns) -> Tuple[str, ...]:
     return tuple(out)
 
 
+def _shared_scale_findings(traced: TracedGraph) -> List[Finding]:
+    """Shared-scale accumulator overflow, statically: a
+    ``payload_algebra='shared_scale'`` codec's integer payload must cover
+    ``world · max_level`` or the payload-space hop/psum sums wrap silently
+    (integer overflow has no inf for the guard to see). The bound is the
+    codec's OWN ``payload_sum_max_world`` — literally the constant the
+    communicators' runtime gate raises from — so, like
+    ``vote_exact_max_world``, the static pass and the runtime check can
+    never disagree. A W=4096 trace of an int8 accumulator fires here; the
+    same codec at W=8 is clean."""
+    from grace_tpu import comm
+
+    grace = traced.meta.get("grace")
+    if grace is None:
+        return []
+    comp = grace.compressor
+    if getattr(comp, "payload_algebra", None) != "shared_scale":
+        return []
+    # Only the payload-summing schedules accumulate W levels in the wire
+    # dtype; a gather decodes per rank and never sums payloads.
+    if not isinstance(grace.communicator,
+                      (comm.Allreduce, comm.RingAllreduce,
+                       comm.HierarchicalAllreduce)):
+        return []
+    bound = comp.payload_sum_max_world()
+    if bound is None or traced.world <= bound:
+        return []
+    return [Finding(
+        pass_name="numeric_safety", config=traced.name,
+        severity="error", stage=STAGE_EXCHANGE,
+        message=(
+            f"{type(comp).__name__} payload-space sum spans "
+            f"world={traced.world} ranks but its integer accumulator "
+            f"carries exact sums only up to world {bound} "
+            "(payload_sum_max_world: iinfo(accum_dtype).max // max level "
+            "— the same constant the communicators' runtime gate "
+            "enforces); beyond it level sums wrap with no NaN/inf for "
+            "the guard to catch — widen accum_dtype or lower quantum_num"),
+        details=(("payload_sum_max_world", int(bound)),
+                 ("world", traced.world)))]
+
+
 def pass_numeric_safety(traced: TracedGraph) -> List[Finding]:
     """Value-range safety of the traced payload arithmetic — the
     silent-saturation class a static pass catches before a chip runs:
@@ -668,6 +710,9 @@ def pass_numeric_safety(traced: TracedGraph) -> List[Finding]:
       mantissa bits are exact only up to ``2^(p+1)`` ranks
       (:func:`grace_tpu.comm.vote_exact_max_world` — the constant the
       runtime guard reads, re-derived from first principles in the tests);
+    * shared-scale integer accumulators must cover ``world · max_level``
+      (:func:`_shared_scale_findings` — the homomorphic-payload twin of
+      the vote bound, from the codec's own ``payload_sum_max_world``);
     * codec payload contracts: selection-index dtypes vs fused leaf sizes,
       and bit-packing width round-trips (:func:`_packing_findings`).
     """
@@ -708,6 +753,7 @@ def pass_numeric_safety(traced: TracedGraph) -> List[Finding]:
                     "vote_dtype='float32'"),
                 details=(("vote_dtype", dtype), ("span", int(span)),
                          ("exact_max_world", int(bound)))))
+    findings.extend(_shared_scale_findings(traced))
     findings.extend(_index_dtype_findings(traced))
     findings.extend(_packing_findings(traced))
     return findings

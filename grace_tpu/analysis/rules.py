@@ -5,11 +5,14 @@ Unlike the jaxpr passes (which audit *traced behavior*), these rules audit
 forever instead of living in review-comment folklore.
 
 * ``compressor-capabilities`` — every ``Compressor`` subclass must declare
-  ``summable_payload`` and ``supports_hop_requant`` in its own class body.
-  These two flags are the communicator compatibility matrix
-  (``Allreduce``/``RingAllreduce`` gate on them); an inherited implicit
-  ``False`` is *probably* right but silently wrong for a new linear codec,
-  and the declaration is the author's signed statement either way.
+  ``payload_algebra`` and ``supports_hop_requant`` in its own class body.
+  These two declarations are the communicator compatibility matrix
+  (``Allreduce``/``RingAllreduce``/``HierarchicalAllreduce`` dispatch
+  their accumulation path on the algebra; ``summable_payload`` is now a
+  property DERIVED from it, so declaring the algebra is the one signed
+  statement); an inherited implicit ``None`` is *probably* right but
+  silently wrong for a new linear/homomorphic codec, and the declaration
+  is the author's signed statement either way.
 * ``telemetry-fields-reducer`` — every ``FIELDS`` entry in
   ``telemetry/state.py`` must name a host-side reducer from the known set;
   the reader aggregates flush bundles by that string and an unknown one
@@ -39,7 +42,7 @@ __all__ = ["RULE_NAMES", "run_repo_rules", "repo_root",
 RULE_NAMES = ("compressor-capabilities", "telemetry-fields-reducer",
               "pytest-marker-registration")
 
-_REQUIRED_CAPS = ("summable_payload", "supports_hop_requant")
+_REQUIRED_CAPS = ("payload_algebra", "supports_hop_requant")
 _KNOWN_REDUCERS = {"first", "mean", "max", "min", "sum"}
 # Markers pytest ships (or plugins this repo uses) — never need registering.
 _BUILTIN_MARKS = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
@@ -136,11 +139,14 @@ def rule_compressor_capabilities(root: str, sources=None) -> List[Finding]:
                     message=(
                         f"{node.name} does not declare "
                         f"{'/'.join(missing)} in its class body — these "
-                        "flags ARE the communicator compatibility matrix "
-                        "(Allreduce payload-space summation, RingAllreduce "
+                        "declarations ARE the communicator compatibility "
+                        "matrix (payload_algebra selects the payload-space "
+                        "accumulation path: exact/shared_scale/sketch/"
+                        "None, from which summable_payload derives; "
+                        "supports_hop_requant opts into RingAllreduce "
                         "per-hop requantization); state them explicitly "
-                        "even when False so the contract is visible at "
-                        "the definition site"),
+                        "even when None/False so the contract is visible "
+                        "at the definition site"),
                     details=(("class", node.name),)))
     return findings
 

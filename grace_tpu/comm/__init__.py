@@ -105,6 +105,31 @@ def _psum_majority_vote(payload: Payload, ctx: Ctx, compressor: Compressor,
     return out.astype(dec.dtype)
 
 
+def _algebra(compressor) -> str | None:
+    """The codec's declared payload algebra (core.PAYLOAD_ALGEBRAS)."""
+    return getattr(compressor, "payload_algebra", None)
+
+
+def _check_payload_sum_world(compressor: Compressor, world: int,
+                             schedule: str) -> None:
+    """Runtime twin of the static shared-scale overflow gate: the payload-
+    space sum of ``world`` ranks must stay exact in the payload dtype —
+    the bound is the codec's OWN ``payload_sum_max_world`` constant (e.g.
+    ``iinfo(accum_dtype).max // quantum_num`` for homomorphic QSGD), the
+    same function flow pass 6 and the tuner's numeric gate evaluate, so
+    the three enforcement points can never disagree (the
+    ``vote_exact_max_world`` pattern)."""
+    bound = compressor.payload_sum_max_world()
+    if bound is not None and world > bound:
+        raise ValueError(
+            f"{schedule} sums {type(compressor).__name__} payloads across "
+            f"{world} ranks but the payload dtype carries exact sums only "
+            f"up to world {bound} (payload_sum_max_world: accumulator "
+            "iinfo.max // max level) — widen accum_dtype or lower "
+            "quantum_num; the numeric_safety pass rejects this statically "
+            "from the same constant.")
+
+
 _MB_UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 
@@ -192,8 +217,22 @@ class Allreduce(Communicator):
                 "differently, e.g. per-rank indices or norms). Use "
                 "Allgather/Broadcast instead — reference compatibility "
                 "matrix, IMPLEMENTING.md:43-45.")
+        homo = _algebra(compressor) in ("shared_scale", "sketch")
+        if homo:
+            _check_payload_sum_world(compressor, axis_size(self.axis_name),
+                                     "Allreduce")
         with trace_stage(f"{STAGE_EXCHANGE}/psum"):
             summed = tuple(_psum(t, self.axis_name) for t in payload)
+        if homo:
+            # Homomorphic decode: integer level sums / merged sketch
+            # tables decode ONCE, and the mean divides the decoded dense
+            # tensor (an int payload cannot carry the /W; a sketch's
+            # median estimate commutes with positive scaling either way).
+            with trace_stage(STAGE_DECOMPRESS):
+                out = compressor.decompress(summed, ctx)
+            if compressor.average:
+                out = out / self.world_size()
+            return out
         if compressor.average and payload:
             if not all(jnp.issubdtype(t.dtype, jnp.inexact) for t in summed):
                 raise TypeError(
@@ -376,7 +415,7 @@ class _ChunkedView:
 
 
 def _shard_compress(compressor: Compressor, chunks: jax.Array,
-                    rng: jax.Array, comm_name: str):
+                    rng: jax.Array, comm_name: str, shared=None):
     """Stage-1 shard encode shared by the shard-parallel communicators
     (TwoShotAllreduce, RingAllreduce): probe one shard to pin the
     (shard-uniform) static ctx structure, then vmap ``compress`` over the
@@ -385,17 +424,29 @@ def _shard_compress(compressor: Compressor, chunks: jax.Array,
     ctx arrays must be data-free so every rank's locally derived ctx for
     shard ``c`` equals the one the sender compressed with (the condition
     that lets ranks decode each other's shard payloads without shipping
-    ctx). Returns ``(payloads, ctx_arrays, treedef, static)`` with payloads
-    and ctx arrays stacked along the shard axis."""
+    ctx). ``shared`` is the hoisted shared-scale negotiation result
+    (``payload_algebra == 'shared_scale'``): when present, every shard
+    encodes against it and the data-free-ctx gate is replaced by the
+    stronger collective-replication argument — the scale came out of a
+    full-axis pmax, so the ctx it seeds is rank-identical by construction
+    even though it is data-derived. Returns ``(payloads, ctx_arrays,
+    treedef, static)`` with payloads and ctx arrays stacked along the
+    shard axis."""
     w = chunks.shape[0]
-    probe_payload, probe_ctx, _ = compressor.compress(
-        chunks[0], None, jax.random.fold_in(rng, 0))
+
+    def enc(chunk, key):
+        if shared is None:
+            return compressor.compress(chunk, None, key)
+        return compressor.compress(chunk, None, key, shared=shared)
+
+    probe_payload, probe_ctx, _ = enc(chunks[0], jax.random.fold_in(rng, 0))
     if not probe_payload:
         raise TypeError(
             f"{comm_name} needs a wire payload to scatter; "
             f"{type(compressor).__name__} communicates inside compress "
             "— use Allreduce instead.")
-    if not ctx_is_data_free(compressor, chunks.shape[1], chunks.dtype):
+    if shared is None and not ctx_is_data_free(compressor, chunks.shape[1],
+                                               chunks.dtype):
         raise TypeError(
             f"{comm_name} requires a data-free ctx; "
             f"{type(compressor).__name__}.compress puts data-derived "
@@ -408,8 +459,7 @@ def _shard_compress(compressor: Compressor, chunks: jax.Array,
     treedef, static, _ = _split_ctx(probe_ctx)
 
     def comp_one(chunk, c):
-        payload, ctx, _ = compressor.compress(
-            chunk, None, jax.random.fold_in(rng, c))
+        payload, ctx, _ = enc(chunk, jax.random.fold_in(rng, c))
         _, _, arrays = _split_ctx(ctx)
         return tuple(payload), tuple(arrays)
 
@@ -580,13 +630,24 @@ class RingAllreduce(Communicator):
     Wire per rank ≈ 2·(W−1)/W·k received (like two-shot) vs allgather's
     (W−1)·k, and the aggregation work is spread around the ring instead of
     replicated on every rank (allgather) or concentrated on the shard owner
-    (two-shot). Two accumulation paths, gated on the compressor — the
-    compatibility matrix is *enforced*, not documented:
+    (two-shot). Three accumulation paths, gated on the compressor's
+    declared ``payload_algebra`` — the compatibility matrix is *enforced*,
+    not documented:
 
-    * **exact path** (``summable_payload=True``: none, fp16/bf16, randomk)
-      — the codec is linear, so hops add wire words directly (payload-space
-      accumulation). No requant round-trip, no per-hop loss beyond the
-      accumulation dtype; phase 2 gathers the summed payloads themselves.
+    * **exact path** (``payload_algebra='exact'``: none, fp16/bf16,
+      randomk) — the codec is linear, so hops add wire words directly
+      (payload-space accumulation). No requant round-trip, no per-hop loss
+      beyond the accumulation dtype; phase 2 gathers the summed payloads
+      themselves.
+    * **homomorphic path** (``payload_algebra='shared_scale'`` — homoqsgd,
+      or ``'sketch'`` — countsketch): same zero-requant hop adds, but the
+      scale negotiation is hoisted before stage 1 (one pmax; ctx becomes
+      rank-identical by collective replication rather than data-freeness),
+      the integer/sketch sums are bounded by the codec's
+      ``payload_sum_max_world`` (runtime gate here, static twin in flow
+      pass 6), and the mean divides AFTER the single final decode. ONE
+      decode for the whole schedule, zero requant regardless of W — the
+      THC regime that kills the tuner's ``MAX_REQUANT_CHAIN`` degradation.
     * **requant path** (``supports_hop_requant=True``: topk, qsgd, signsgd)
       — decompress → accumulate → requantize at each hop with a shared hop
       key (data-free ctx lets the receiver derive the sender's ctx
@@ -623,15 +684,18 @@ class RingAllreduce(Communicator):
                 f"{type(compressor).__name__} carries cross-step state "
                 "(init_state != None) that has no per-shard meaning — use "
                 "Allgather/Allreduce instead.")
+        algebra = _algebra(compressor)
+        homo = algebra in ("shared_scale", "sketch")
         exact = bool(getattr(compressor, "summable_payload", False))
         requant = bool(getattr(compressor, "supports_hop_requant", False))
         if not (exact or requant):
             raise TypeError(
                 f"RingAllreduce keeps the payload compressed on every hop, "
-                "which needs either a linear codec (summable_payload=True: "
-                "none/fp16/randomk — exact payload-space accumulation) or "
-                "one that opts into per-hop requantization "
-                "(supports_hop_requant=True: topk/qsgd/signsgd); "
+                "which needs a payload algebra (exact: none/fp16/randomk; "
+                "shared_scale: homoqsgd; sketch: countsketch — all give "
+                "exact payload-space accumulation) or an opt-in to per-hop "
+                "requantization (supports_hop_requant=True: "
+                "topk/qsgd/signsgd); "
                 f"{type(compressor).__name__} declares neither — its "
                 "payload carries structure a partial sum destroys. Use "
                 "Allgather (general-purpose) or TwoShotAllreduce instead.")
@@ -640,11 +704,22 @@ class RingAllreduce(Communicator):
         flat = compensated.reshape(-1)
         n = flat.size
         w, _, pad = self.shard_spec(n)              # static at trace time
+        if homo:
+            _check_payload_sum_world(compressor, w, "RingAllreduce")
         chunks = jnp.pad(flat, (0, pad)).reshape(w, -1)
+
+        # Shared-scale negotiation, hoisted before stage 1 over the WHOLE
+        # buffer (one per-bucket scale, not per shard): every shard then
+        # encodes against the identical replicated scale, so hop sums are
+        # exact and error feedback covers this single encode.
+        shared = None
+        if algebra == "shared_scale":
+            with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
+                shared = compressor.negotiate(flat, self.axis_name)
 
         with trace_stage(f"{STAGE_EXCHANGE}/ring_stage1_compress"):
             payloads, ctx_arrays, treedef, static = _shard_compress(
-                compressor, chunks, rng, "RingAllreduce")
+                compressor, chunks, rng, "RingAllreduce", shared=shared)
 
         # Error feedback covers the stage-1 encode exactly (the hop requant
         # losses are downstream of it, like two-shot's stage-2 loss).
@@ -665,7 +740,10 @@ class RingAllreduce(Communicator):
         if exact:
             # Payload-space accumulation: decode-the-sum == sum-the-decodes
             # (the Allreduce linearity condition), so the wire format IS
-            # the accumulator and phase 2 needs no re-encode.
+            # the accumulator and phase 2 needs no re-encode. The same
+            # hops serve all three algebras — homomorphic (shared_scale /
+            # sketch) payloads add exactly as integers/merged tables, with
+            # ZERO requant at any hop regardless of W.
             send = take_payload(payloads, (i - 1) % w)
             for s in range(w - 1):
                 with trace_stage(f"{STAGE_RING_HOP}/{s}"):
@@ -674,7 +752,7 @@ class RingAllreduce(Communicator):
                     own = take_payload(payloads, (i - 2 - s) % w)
                     send = tuple(r + o for r, o in zip(recv, own))
             owned = send                 # wire-format reduction of shard i
-            if compressor.average:
+            if compressor.average and not homo:
                 if not all(jnp.issubdtype(t.dtype, jnp.inexact)
                            for t in owned):
                     raise TypeError(
@@ -682,7 +760,8 @@ class RingAllreduce(Communicator):
                         f"payloads; got {[t.dtype for t in owned]} — "
                         "integer-coded payloads cannot carry the mean "
                         "(reference compatibility matrix, "
-                        "IMPLEMENTING.md:43-45).")
+                        "IMPLEMENTING.md:43-45; shared_scale/sketch "
+                        "algebras divide after the final decode instead).")
                 owned = tuple(t / w for t in owned)
             with trace_stage(f"{STAGE_EXCHANGE}/ring_all_gather"):
                 gathered = tuple(
@@ -696,6 +775,11 @@ class RingAllreduce(Communicator):
                         p, _join_ctx(treedef, static, list(arrs)))
 
                 out = jax.vmap(dec)(gathered, ctx_arrays)
+            if homo and compressor.average:
+                # The ONE decode already happened; an int-level/sketch
+                # payload cannot carry /W, so the mean lands on the dense
+                # result — bit-equal placement to the escape psum's /W.
+                out = out / w
         else:
             hop_ctx = None
             send = take_payload(payloads, (i - 1) % w)
@@ -874,21 +958,30 @@ class HierarchicalAllreduce(Communicator):
                 f"{type(compressor).__name__} carries cross-step state "
                 "(init_state != None) that has no per-shard meaning — use "
                 "Allgather/Allreduce instead.")
+        algebra = _algebra(compressor)
+        homo = algebra in ("shared_scale", "sketch")
         exact = bool(getattr(compressor, "summable_payload", False))
         requant = bool(getattr(compressor, "supports_hop_requant", False))
         if not (exact or requant):
             raise TypeError(
                 f"HierarchicalAllreduce keeps the payload compressed on "
                 "every hop and re-aggregates the per-slice partials, which "
-                "needs either a linear codec (summable_payload=True: "
-                "none/fp16/randomk — exact payload-space accumulation) or "
-                "one that opts into per-hop requantization "
+                "needs a payload algebra (exact: none/fp16/randomk; "
+                "shared_scale: homoqsgd; sketch: countsketch — exact "
+                "payload-space accumulation through BOTH levels) or an "
+                "opt-in to per-hop requantization "
                 "(supports_hop_requant=True: topk/qsgd/signsgd); "
                 f"{type(compressor).__name__} declares neither — its "
                 "payload carries structure a partial sum destroys. Use "
                 "Allgather (general-purpose) or TwoShotAllreduce instead.")
         w = axis_size(self.axis_name)            # static at trace time
         s, k = self._split(w)
+        # The full two-level sum spans W = K·S ranks (S-term intra-slice
+        # partials, K of them summed at the boundary), so the shared-scale
+        # accumulator bound is on W — not S — exactly as the static gate
+        # prices it.
+        if homo:
+            _check_payload_sum_world(compressor, w, "HierarchicalAllreduce")
         shape, dtype = x.shape, x.dtype
         compensated, mem_state = memory.compensate(x, mem_state)
         flat = compensated.reshape(-1)
@@ -896,9 +989,19 @@ class HierarchicalAllreduce(Communicator):
         pad = (-n) % s
         chunks = jnp.pad(flat, (0, pad)).reshape(s, -1)
 
+        # Shared-scale negotiation hoisted before stage 1: ONE full-axis
+        # pmax (not per slice — a per-slice scale would break the
+        # cross-slice payload sum), so the boundary exchange stays a pure
+        # integer add with zero requant regardless of K.
+        shared = None
+        if algebra == "shared_scale":
+            with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
+                shared = compressor.negotiate(flat, self.axis_name)
+
         with trace_stage(f"{STAGE_EXCHANGE}/hier_stage1_compress"):
             payloads, ctx_arrays, treedef, static = _shard_compress(
-                compressor, chunks, rng, "HierarchicalAllreduce")
+                compressor, chunks, rng, "HierarchicalAllreduce",
+                shared=shared)
 
         # Error feedback covers the stage-1 shard encode exactly; the
         # intra-slice hop requants and the one slice-boundary re-encode
@@ -936,6 +1039,9 @@ class HierarchicalAllreduce(Communicator):
         if exact:
             # Phase 1: payload-space ring reduce-scatter over the slice
             # sub-axis — identical hop logic to RingAllreduce with W -> S.
+            # Serves all three algebras: homomorphic payloads (integer
+            # levels under the hoisted shared scale, mergeable sketch
+            # tables) hop-add with zero requant.
             send = take_payload(payloads, (local - 1) % s)
             for hop in range(s - 1):
                 with trace_stage(f"{STAGE_RING_HOP}/{hop}"):
@@ -944,17 +1050,24 @@ class HierarchicalAllreduce(Communicator):
                     own = take_payload(payloads, (local - 2 - hop) % s)
                     send = tuple(r + o for r, o in zip(recv, own))
             partial = send       # wire-format slice partial of shard `local`
-            # Phase 2: the codec is linear, so the cross-slice exchange is
+            # Phase 2: the payload algebra makes the cross-slice exchange
             # an exact payload-space sum of the K slice partials — no
-            # requant, no extra loss, and only ~payload/S rides DCN.
+            # boundary requant (the requant path's ONE remaining re-encode
+            # point, now zero), no extra loss, and only ~payload/S rides
+            # DCN.
             if k > 1:
                 stacked = gather_groups(
                     partial, cross_groups,
                     f"{STAGE_EXCHANGE}/hier_cross_slice")
-                owned = tuple(jnp.sum(t, axis=0) for t in stacked)
+                # dtype pinned to the wire dtype: numpy promotion would
+                # silently widen integer level sums to int32 here, but the
+                # accumulator width is the codec's declared contract
+                # (payload_sum_max_world bounds W so THIS dtype is enough).
+                owned = tuple(jnp.sum(t, axis=0, dtype=t.dtype)
+                              for t in stacked)
             else:
                 owned = partial
-            if compressor.average:
+            if compressor.average and not homo:
                 if not all(jnp.issubdtype(t.dtype, jnp.inexact)
                            for t in owned):
                     raise TypeError(
@@ -962,7 +1075,8 @@ class HierarchicalAllreduce(Communicator):
                         f"float payloads; got {[t.dtype for t in owned]} — "
                         "integer-coded payloads cannot carry the mean "
                         "(reference compatibility matrix, "
-                        "IMPLEMENTING.md:43-45).")
+                        "IMPLEMENTING.md:43-45; shared_scale/sketch "
+                        "algebras divide after the final decode instead).")
                 owned = tuple(t / w for t in owned)
             # Phase 3: gather the S reduced shards within the slice, still
             # in wire format; gathered[j] is local rank j's shard == shard
@@ -975,6 +1089,11 @@ class HierarchicalAllreduce(Communicator):
                         p, _join_ctx(treedef, static, list(arrs)))
 
                 out = jax.vmap(dec)(gathered, ctx_arrays)
+            if homo and compressor.average:
+                # One decode for the whole two-level schedule; the mean
+                # divides the dense result (int/sketch payloads cannot
+                # carry /W).
+                out = out / w
         else:
             # Phase 1: decompress -> accumulate -> requantize per intra
             # hop (shared hop keys; the receiver derives the sender's

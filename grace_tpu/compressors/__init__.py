@@ -12,6 +12,8 @@ from grace_tpu.compressors.topk import TopKCompressor
 from grace_tpu.compressors.randomk import RandomKCompressor
 from grace_tpu.compressors.threshold import ThresholdCompressor
 from grace_tpu.compressors.qsgd import QSGDCompressor
+from grace_tpu.compressors.homoqsgd import HomoQSGDCompressor
+from grace_tpu.compressors.countsketch import CountSketchCompressor
 from grace_tpu.compressors.terngrad import TernGradCompressor
 from grace_tpu.compressors.signsgd import SignSGDCompressor, SignumCompressor
 from grace_tpu.compressors.efsignsgd import EFSignSGDCompressor
@@ -26,7 +28,8 @@ from grace_tpu.compressors.inceptionn import InceptionNCompressor
 
 __all__ = [
     "NoneCompressor", "FP16Compressor", "TopKCompressor", "RandomKCompressor",
-    "ThresholdCompressor", "QSGDCompressor", "TernGradCompressor",
+    "ThresholdCompressor", "QSGDCompressor", "HomoQSGDCompressor",
+    "CountSketchCompressor", "TernGradCompressor",
     "SignSGDCompressor", "SignumCompressor", "EFSignSGDCompressor",
     "OneBitCompressor", "NaturalCompressor", "DgcCompressor",
     "PowerSGDCompressor", "SketchCompressor", "U8bitCompressor",

@@ -30,8 +30,9 @@ from grace_tpu.ops.packing import pack_bits, unpack_bits
 class AdaqCompressor(Compressor):
     tensors_size_are_same = False
     # Per-rank group means over per-rank selections: payloads decode
-    # against rank-local structure a sum (or partial sum) destroys.
-    summable_payload = False
+    # against rank-local structure a sum (or partial sum) destroys — no
+    # payload algebra.
+    payload_algebra = None
     supports_hop_requant = False
 
     compress_ratio: float = 0.01

@@ -1,0 +1,94 @@
+"""Mergeable count-sketch codec: sign-hash tables that add exactly.
+
+The second aggregation-homomorphic family (THC / SketchML lineage,
+PAPERS.md): project the gradient into ``rows`` independent sign-hash
+tables — ``table[r, h_r(i)] += s_r(i) · x[i]`` — and estimate each
+coordinate on decode as the median over rows of ``s_r(i) ·
+table[r, h_r(i)]``. The load-bearing property is **linearity of the
+encode**: ``sketch(x) + sketch(y) == sketch(x + y)`` bit-for-bit up to
+float associativity, because the hash/sign streams derive from the SHARED
+replicated rng key every rank holds (the same contract RandomK's shared
+indices ride). So every ring hop and slice boundary adds tables in payload
+space with zero merge loss, and the single decode at the very end pays ONE
+estimation error instead of the W a decode-each-then-aggregate gather
+pays. Unlike the quantile :class:`~grace_tpu.compressors.sketch
+.SketchCompressor` (whose per-rank bin edges shift and compose not at
+all), the hash structure lives in ctx — derived from rng alone, so it is
+data-free and the shard-parallel communicators' locally-derived-ctx decode
+is sound without shipping it.
+
+Wire cost: ``rows · width`` f32 cells with ``width = ceil(ratio · n /
+rows)`` — ``compress_ratio`` is the total table-cells-per-element budget,
+so the payload is ``ratio · n`` floats regardless of ``rows``. The
+estimate is unbiased with collision noise ~ ||x||/√width per cell; the
+median over odd ``rows`` suppresses heavy-collision outliers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+
+
+@dataclasses.dataclass(frozen=True)
+class CountSketchCompressor(Compressor):
+    # Linear mergeable sketches: tables add exactly across ranks/hops; ONE
+    # median-estimate decode at the end of the schedule.
+    payload_algebra = "sketch"
+    # Re-sketching a partial sum is pointless — merging IS exact.
+    supports_hop_requant = False
+
+    compress_ratio: float = 0.25   # total table cells per input element
+    rows: int = 3                  # independent hash rows (odd: true median)
+
+    def __post_init__(self):
+        if not 0.0 < self.compress_ratio <= 1.0:
+            raise ValueError(f"compress_ratio must be in (0, 1]; got "
+                             f"{self.compress_ratio}")
+        if self.rows < 1 or self.rows % 2 == 0:
+            raise ValueError(f"rows must be a positive odd count (median "
+                             f"estimation); got {self.rows}")
+
+    def _width(self, numel: int) -> int:
+        return max(1, math.ceil(self.compress_ratio * numel / self.rows))
+
+    def _hashes(self, rng: jax.Array, numel: int):
+        """(idx, signs): per-row bucket indices and ±1 signs for every
+        coordinate, drawn from the SHARED rng key — rank-identical by the
+        replicated-key contract, hence mergeable payloads and a data-free
+        ctx (the ring/hier soundness condition)."""
+        width = self._width(numel)
+        kidx, ksign = jax.random.split(jax.random.fold_in(rng, 0x5ce7c))
+        idx = jax.random.randint(kidx, (self.rows, numel), 0, width,
+                                 dtype=jnp.int32)
+        signs = jax.random.rademacher(ksign, (self.rows, numel),
+                                      dtype=jnp.int8)
+        return idx, signs
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
+                 ) -> tuple[Payload, Ctx, State]:
+        shape = x.shape
+        flat = x.reshape(-1).astype(jnp.float32)
+        numel = flat.size
+        width = self._width(numel)
+        idx, signs = self._hashes(rng, numel)
+
+        def row(i, s):
+            return jax.ops.segment_sum(s.astype(jnp.float32) * flat, i,
+                                       num_segments=width)
+
+        table = jax.vmap(row)(idx, signs)          # (rows, width) f32
+        return (table,), (idx, signs, shape, x.dtype), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        (table,) = payload
+        idx, signs, shape, dtype = ctx
+        est = signs.astype(jnp.float32) * jnp.take_along_axis(
+            table, idx, axis=1)                    # (rows, numel)
+        out = jnp.median(est, axis=0)
+        return out.reshape(shape).astype(dtype)

@@ -27,9 +27,9 @@ from grace_tpu.ops.sparse import scatter_dense
 class DgcCompressor(Compressor):
     tensors_size_are_same = False
     # Capacity-masked (values, per-rank indices): summing payloads mixes
-    # entries at different coordinates, and a partial sum destroys the
-    # sampled-threshold capacity mask a re-encode would need.
-    summable_payload = False
+    # entries at different coordinates (no algebra), and a partial sum
+    # destroys the sampled-threshold capacity mask a re-encode would need.
+    payload_algebra = None
     supports_hop_requant = False
 
     compress_ratio: float = 0.01

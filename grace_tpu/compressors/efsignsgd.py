@@ -22,8 +22,8 @@ from grace_tpu.ops.packing import pack_bits, unpack_bits
 class EFSignSGDCompressor(Compressor):
     average = False
     # Payload is (packed signs, per-rank 1/lr·mean scale): sign bytes don't
-    # sum and the scale pair has no meaning over a partial sum.
-    summable_payload = False
+    # sum (no algebra) and the scale pair has no meaning over a partial sum.
+    payload_algebra = None
     supports_hop_requant = False
 
     lr: float = 0.1

@@ -19,7 +19,10 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 @dataclasses.dataclass(frozen=True)
 class FP16Compressor(Compressor):
     dtype: str = "bfloat16"
-    summable_payload = True
+    # Downcast is linear: half-precision payloads add meaningfully (the
+    # accumulation dtype's saturation is flow pass 6's fp16 cliff, not a
+    # composition failure).
+    payload_algebra = "exact"
     # Linear codec: the exact payload-space ring path applies; no requant.
     supports_hop_requant = False
 

@@ -1,0 +1,132 @@
+"""Homomorphic (shared-scale) QSGD: aggregation adds payloads directly.
+
+THC-style aggregation-friendly quantization (PAPERS.md; also the regime
+EQuARX's in-XLA quantized allreduce lives in): classic QSGD scales each
+rank's levels by its OWN norm, so payloads decode differently per rank and
+every multi-hop schedule must decompress → accumulate → requantize — the
+per-hop loss that grows ~linearly in hop count and forced the tuner's
+``MAX_REQUANT_CHAIN`` degradation gate (grace_tpu/tuning/prune.py). The
+fix is to negotiate ONE scale before encoding:
+
+1. **negotiate** — one ``lax.pmax`` of the local max magnitude over the
+   mesh axis (a scalar collective, priced via
+   :meth:`negotiation_nbytes`); every rank now holds the identical shared
+   scale, hoisted by the communicators BEFORE the stage-1 encode so error
+   feedback covers the single encode exactly;
+2. **encode** — stochastic-round ``quantum_num * x / scale`` to signed
+   integer LEVELS in ``[-quantum_num, quantum_num]``, shipped in an
+   integer accumulator dtype wide enough that ``world`` ranks sum without
+   overflow (``payload_sum_max_world`` = ``iinfo(accum_dtype).max //
+   quantum_num`` — ONE constant, enforced at runtime by the communicators'
+   homomorphic paths and statically by flow pass 6 and the tuner's
+   numeric gate, mirroring ``comm.vote_exact_max_world``);
+3. **aggregate** — every ring hop / slice boundary / psum adds the integer
+   levels **in payload space**: zero re-encode loss, zero decode compute
+   on the critical path, ONE decode at the very end
+   (``scale / quantum_num * summed_levels``).
+
+Wire cost: ``itemsize(accum_dtype)`` bytes per element — int16 (the
+default) matches fp16's wire width while carrying exact sums for worlds up
+to ``32767 // quantum_num`` (4681 at the 4-bit ``quantum_num=7``). The
+win over fp16 is not bytes, it is the *quality* story: hop-count-
+independent compression error at ring/hier's O(k) wire cost, where plain
+qsgd pays W−2 intermediate requants and topk re-selects every hop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import Compressor, Ctx, Payload, State
+
+
+@dataclasses.dataclass(frozen=True)
+class HomoQSGDCompressor(Compressor):
+    # Integer levels under ONE negotiated scale: payloads add exactly in
+    # integer space (the whole point of this codec) — the communicators'
+    # zero-requant homomorphic path dispatches on this.
+    payload_algebra = "shared_scale"
+    # Hop requant would reintroduce exactly the per-hop loss the shared
+    # scale exists to kill; the homomorphic path makes it unreachable.
+    supports_hop_requant = False
+
+    quantum_num: int = 7          # 4-bit levels, the qsgd4 wire family
+    accum_dtype: str = "int16"    # payload/accumulator width (int8/16/32)
+
+    def __post_init__(self):
+        dt = jnp.dtype(self.accum_dtype)
+        if not jnp.issubdtype(dt, jnp.signedinteger):
+            raise ValueError(f"accum_dtype must be a signed integer dtype "
+                             f"(the payload IS the accumulator); got "
+                             f"{self.accum_dtype!r}")
+        if self.quantum_num < 1:
+            raise ValueError(f"quantum_num must be >= 1; got "
+                             f"{self.quantum_num}")
+        if self.quantum_num > int(jnp.iinfo(dt).max):
+            raise ValueError(
+                f"quantum_num={self.quantum_num} does not even fit ONE "
+                f"rank's level in {dt.name} (max {int(jnp.iinfo(dt).max)})")
+
+    # -- the ONE overflow constant ------------------------------------------
+    def payload_sum_max_world(self) -> int:
+        """Largest world whose payload-space sum stays exact: each rank
+        contributes a level in ``[-quantum_num, quantum_num]``, so a W-rank
+        sum lives in ``[-W·q, W·q]`` and is exact iff ``W·q <=
+        iinfo(accum_dtype).max``. int16 @ q=7 → 4681; int8 @ q=7 → 18 (a
+        W=32 mesh fires the static numeric-safety finding AND the runtime
+        gate from this same function)."""
+        return int(jnp.iinfo(jnp.dtype(self.accum_dtype)).max) \
+            // self.quantum_num
+
+    # -- negotiation ---------------------------------------------------------
+    def negotiate(self, x: jax.Array, axis_name: str) -> jax.Array:
+        """The shared-scale collective: pmax of the local max magnitude
+        over the axis. Replicated by construction — every rank computes
+        the identical scale, which is what makes the level payloads (and
+        the decode ctx) rank-identical without shipping ctx."""
+        local = jnp.max(jnp.abs(x.reshape(-1))).astype(jnp.float32)
+        return lax.pmax(local, axis_name)
+
+    def negotiation_nbytes(self, world: int) -> int:
+        # One f32 scalar through a ring-style reduction: 2·4·(W−1)/W bytes
+        # received per rank — the same schedule model recv_wire_bytes uses
+        # for psums, applied to the 4-byte pmax operand.
+        return 2 * 4 * max(0, world - 1) // max(1, world)
+
+    # -- codec ---------------------------------------------------------------
+    def compress(self, x: jax.Array, state: State, rng: jax.Array,
+                 shared: jax.Array | None = None
+                 ) -> tuple[Payload, Ctx, State]:
+        """Encode against ``shared`` (the negotiated scale) when the
+        communicator hoisted a negotiation; fall back to the local max
+        magnitude otherwise (single-rank/Identity use and shape-only
+        traces — a local scale decodes this rank's own payload exactly,
+        it just isn't homomorphic)."""
+        shape = x.shape
+        flat = x.reshape(-1)
+        scale = (jnp.asarray(shared, jnp.float32) if shared is not None
+                 else jnp.max(jnp.abs(flat)).astype(jnp.float32))
+        q = float(self.quantum_num)
+        level_float = jnp.where(
+            scale > 0, q / scale * jnp.abs(flat).astype(jnp.float32), 0.0)
+        previous = jnp.floor(level_float)
+        prob = jax.random.uniform(rng, flat.shape)
+        level = previous + (prob < (level_float - previous))
+        # |x| <= scale under a pmax'd shared scale, so levels stay within
+        # ±q by construction; the clip only guards the local-scale
+        # fallback's float edge cases.
+        signed = jnp.clip(level * jnp.sign(flat.astype(jnp.float32)), -q, q)
+        levels = signed.astype(jnp.dtype(self.accum_dtype))
+        return (levels,), (shape, x.dtype, scale), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        """Linear in the (possibly hop-summed) levels: ``scale/q · levels``
+        — decode-of-the-sum IS the sum-of-decodes, exactly."""
+        (levels,) = payload
+        shape, dtype, scale = ctx
+        out = scale / self.quantum_num * levels.astype(jnp.float32)
+        return out.reshape(shape).astype(dtype)

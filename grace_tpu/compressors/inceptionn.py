@@ -53,9 +53,10 @@ def _floor_log2(x: jax.Array) -> jax.Array:
 @dataclasses.dataclass(frozen=True)
 class InceptionNCompressor(Compressor):
     tensors_size_are_same = False
-    # Variable-width exponent bit packing: code words don't sum and a
-    # partial sum has no bounded re-encode through the packing.
-    summable_payload = False
+    # Variable-width exponent bit packing: code words don't sum (no
+    # algebra) and a partial sum has no bounded re-encode through the
+    # packing.
+    payload_algebra = None
     supports_hop_requant = False
 
     error_bound: float = 1e-4

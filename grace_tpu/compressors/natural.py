@@ -28,9 +28,10 @@ _MAX_BIASED_EXP = 145  # reference clip: 0b01001000100... = 145 << 23
 
 @dataclasses.dataclass(frozen=True)
 class NaturalCompressor(Compressor):
-    # Integer exponent/sign codes: adding two ranks' code words is garbage,
-    # and there is no bounded re-encode of a partial sum.
-    summable_payload = False
+    # Integer exponent/sign codes: adding two ranks' code words is garbage
+    # (no algebra — unlike shared-scale LEVELS, these ints are codes), and
+    # there is no bounded re-encode of a partial sum.
+    payload_algebra = None
     supports_hop_requant = False
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array

@@ -20,7 +20,8 @@ class NoneCompressor(Compressor):
     """
 
     average: bool = True
-    summable_payload = True
+    # Identity payload IS the tensor: sums compose exactly by definition.
+    payload_algebra = "exact"
     # Linear codec: the exact payload-space ring path applies; a requant
     # round-trip would add nothing but work.
     supports_hop_requant = False

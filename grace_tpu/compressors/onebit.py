@@ -21,8 +21,9 @@ from grace_tpu.ops.packing import pack_bits, unpack_bits
 @dataclasses.dataclass(frozen=True)
 class OneBitCompressor(Compressor):
     # Payload is (packed sign mask, mean-of-negatives, mean-of-positives):
-    # the mean pair has no meaning summed across ranks or over a partial.
-    summable_payload = False
+    # the mean pair has no meaning summed across ranks or over a partial —
+    # no payload algebra.
+    payload_algebra = None
     supports_hop_requant = False
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array

@@ -53,8 +53,8 @@ class PowerSGDCompressor(Compressor):
     axis_name: str = DEFAULT_AXIS
     # 1-D leaves ride the communicator dense; >=2-D leaves were already
     # psum-reduced inside compress, so the outer allreduce sees a replicated
-    # payload that sums/averages consistently.
-    summable_payload = True
+    # payload that sums/averages consistently — exact composition.
+    payload_algebra = "exact"
     # Communicates inside compress and carries cross-step Q state — the
     # shard-parallel communicators reject it before capability gating.
     supports_hop_requant = False

@@ -38,8 +38,10 @@ class QSGDCompressor(Compressor):
     # multi-hop accumulation regime). Errors add over the W-2 intermediate
     # hops; raise quantum_num on large rings if the tail matters.
     supports_hop_requant = True
-    # Quantized levels decode against each rank's own norm — not summable.
-    summable_payload = False
+    # Quantized levels decode against each rank's own norm — no payload
+    # algebra (the shared-scale variant is HomoQSGDCompressor, whose one
+    # negotiated scale is exactly what makes the levels summable).
+    payload_algebra = None
 
     quantum_num: int = 64
     # Fused Pallas TPU kernel for the quantize step (in-core PRNG, one HBM

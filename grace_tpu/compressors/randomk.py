@@ -26,8 +26,8 @@ from grace_tpu.compressors.topk import static_k
 class RandomKCompressor(Compressor):
     compress_ratio: float = 0.3
     # Indices come from a shared fold_in key, so every rank selects the same
-    # entries and payload values sum meaningfully (reference randomk.py:26-29).
-    summable_payload = True
+    # entries and payload values sum exactly (reference randomk.py:26-29).
+    payload_algebra = "exact"
     # Linear codec: the exact payload-space ring path applies; no requant.
     supports_hop_requant = False
 

@@ -36,8 +36,8 @@ class SignSGDCompressor(Compressor):
     # flag but is stateful, so the ring's stateless gate rejects it first.)
     supports_hop_requant = True
     # Packed sign bytes: psumming them is garbage — the vote routes exist
-    # precisely because the payload is not summable.
-    summable_payload = False
+    # precisely because the payload has no composition algebra.
+    payload_algebra = None
 
     # Fused Pallas sign-bitpack kernel (grace_tpu/ops/pallas_quant.sign_pack):
     # the packed sign mask leaves VMEM wire-ready instead of staging a full
@@ -106,8 +106,8 @@ class SignumCompressor(SignSGDCompressor):
     # Restated (not just inherited) per the graft-lint capability rule:
     # stateful momentum makes the shard-parallel communicators reject
     # Signum at the stateless gate, so it must not advertise hop requant
-    # it can never use; sign bytes are as unsummable as the parent's.
-    summable_payload = False
+    # it can never use; sign bytes are as algebra-free as the parent's.
+    payload_algebra = None
     supports_hop_requant = False
 
     momentum: float = 0.9

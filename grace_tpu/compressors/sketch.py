@@ -19,9 +19,10 @@ from grace_tpu.core import Compressor, Ctx, Payload, State
 
 @dataclasses.dataclass(frozen=True)
 class SketchCompressor(Compressor):
-    # Bin indices against per-rank quantile edges: neither summable nor
-    # re-encodable over a partial sum (the bins themselves shift).
-    summable_payload = False
+    # Bin indices against per-rank quantile edges: no payload algebra
+    # (the bins themselves shift per rank — the MERGEABLE sketch is
+    # CountSketchCompressor) and no bounded re-encode over a partial sum.
+    payload_algebra = None
     supports_hop_requant = False
 
     bins: int = 64

@@ -20,9 +20,10 @@ from grace_tpu.ops.packing import pack_2bit, unpack_2bit
 @dataclasses.dataclass(frozen=True)
 class TernGradCompressor(Compressor):
     # Per-rank max-scale ternary levels: payloads decode against each rank's
-    # own scaler (not summable), and re-ternarizing a partial sum compounds
-    # the stochastic scale without a validated bound — Allgather only.
-    summable_payload = False
+    # own scaler (no algebra; the shared-scale fix is HomoQSGDCompressor),
+    # and re-ternarizing a partial sum compounds the stochastic scale
+    # without a validated bound — Allgather only.
+    payload_algebra = None
     supports_hop_requant = False
 
     clip_factor: float = 2.5

@@ -35,9 +35,9 @@ from grace_tpu.ops.sparse import scatter_dense
 class ThresholdCompressor(Compressor):
     tensors_size_are_same = False
     # (values, per-rank indices) under a capacity mask: sums mix
-    # coordinates, and the τ-mask of a partial sum is not a re-encode of
-    # the members' masks.
-    summable_payload = False
+    # coordinates (no algebra), and the τ-mask of a partial sum is not a
+    # re-encode of the members' masks.
+    payload_algebra = None
     supports_hop_requant = False
 
     threshold: float = 0.01

@@ -48,8 +48,9 @@ class TopKCompressor(Compressor):
     # per-hop selection error. Sound for any selection algorithm here.
     supports_hop_requant = True
     # Per-rank index sets: summing payloads adds values belonging to
-    # different coordinates (the reference's silent topk+Allreduce bug).
-    summable_payload = False
+    # different coordinates (the reference's silent topk+Allreduce bug) —
+    # no payload algebra, requant is the only hop-pipelined route.
+    payload_algebra = None
 
     compress_ratio: float = 0.3
     algorithm: str = "exact"      # 'exact' | 'approx' | 'chunk'

@@ -45,8 +45,9 @@ def _dynamic_tree_codebook() -> np.ndarray:
 @dataclasses.dataclass(frozen=True)
 class U8bitCompressor(Compressor):
     # Codebook-indexed bytes scaled by a per-rank max: index sums are
-    # garbage and the codebook re-encode of a partial sum is unvalidated.
-    summable_payload = False
+    # garbage (no algebra) and the codebook re-encode of a partial sum is
+    # unvalidated.
+    payload_algebra = None
     supports_hop_requant = False
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array

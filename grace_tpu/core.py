@@ -50,6 +50,35 @@ State = Any
 
 DEFAULT_AXIS = "data"
 
+# The payload-algebra vocabulary (Compressor.payload_algebra): HOW a codec's
+# wire payloads compose under element-wise addition across ranks. This is
+# the capability the communicators' accumulation paths dispatch on and the
+# static analyzers verify — promoted from the old summable_payload bool
+# (which survives as a derived property) so the THC-style homomorphic
+# codecs can say *which* kind of summable they are:
+#
+# * "exact"        — decompress(sum of payloads) == sum of decompresses,
+#                    bit-for-bit up to float associativity (none, fp16,
+#                    randomk's shared-index values, powersgd's in-compress
+#                    sum). Float payloads; averaging may divide the payload.
+# * "shared_scale" — integer level payloads under ONE scale negotiated
+#                    across ranks before encoding (a psum-max collective;
+#                    Compressor.negotiate). Payloads add exactly in integer
+#                    space — zero re-encode loss per hop — but the
+#                    accumulator dtype must cover world * max_level
+#                    (Compressor.payload_sum_max_world, enforced at runtime
+#                    by the communicators and statically by flow pass 6),
+#                    and averaging must divide AFTER the final decode.
+# * "sketch"       — linear mergeable sketches (count-sketch tables):
+#                    sketch(x) + sketch(y) == sketch(x + y) exactly, so
+#                    hop sums merge sketches with zero loss and ONE decode
+#                    estimation at the very end (better than
+#                    decode-each-then-sum, which pays W estimation errors).
+# * None           — per-rank payloads do not compose (per-rank norms,
+#                    selection masks, quantile bins); the hop-pipelined
+#                    schedules need supports_hop_requant or a gather.
+PAYLOAD_ALGEBRAS = ("exact", "shared_scale", "sketch")
+
 # Tolerance contract of the Communicator.recv_wire_bytes model, enforced by
 # the static auditor's wire-byte reconciliation pass (grace_tpu.analysis):
 # the model must agree with the bytes counted from the actually-traced
@@ -237,13 +266,21 @@ class Compressor:
       re-signs the sum and would silently drop any other aggregate's
       scaling (e.g. EF-SignSGD's 1/lr); the generic ``Allreduce`` also
       routes vote compressors through that psum-vote path.
-    * ``summable_payload`` — True iff summing payloads element-wise across
-      ranks then decompressing once equals decompress-each-then-aggregate,
-      i.e. the codec is linear in the payload (none, fp16/bf16, randomk —
-      shared indices; powersgd sums inside compress). The reference only
-      *documents* this compatibility matrix (IMPLEMENTING.md:43-45) and
-      silently corrupts gradients for e.g. topk+Allreduce; here ``Allreduce``
-      enforces it. Default False: a new codec must opt in.
+    * ``payload_algebra`` — the declared composition law of the wire
+      payload under cross-rank addition (:data:`PAYLOAD_ALGEBRAS`):
+      ``"exact"`` (linear float payloads — none, fp16/bf16, randomk,
+      powersgd), ``"shared_scale"`` (integer levels under one negotiated
+      scale — homomorphic QSGD), ``"sketch"`` (mergeable linear sketches —
+      count-sketch), or ``None`` (payloads do not compose). The reference
+      only *documents* the summability matrix (IMPLEMENTING.md:43-45) and
+      silently corrupts gradients for e.g. topk+Allreduce; here the
+      communicators enforce it and dispatch their accumulation path on it.
+      Default None: a new codec must opt in, explicitly, in its own class
+      body (the ``compressor-capabilities`` AST rule).
+    * ``summable_payload`` — derived, read-only: ``payload_algebra is not
+      None``. Kept so every existing call site (communicator gates, tuner
+      mirrors, escape-hatch validation) reads the same truth it always did;
+      the algebra refines it, never contradicts it.
     * ``supports_hop_requant`` — True iff re-running ``compress`` on a
       *partial sum of decompressed tensors* is a sane (bounded-error)
       re-encoding, which is what the hop-pipelined
@@ -261,8 +298,45 @@ class Compressor:
     average = True
     tensors_size_are_same = True
     vote_aggregate = False
-    summable_payload = False
+    payload_algebra = None
     supports_hop_requant = False
+
+    @property
+    def summable_payload(self) -> bool:
+        """Derived from :attr:`payload_algebra` — True iff payloads compose
+        under element-wise addition at all. The pre-algebra bool every
+        call site already reads; a codec never declares it directly."""
+        return self.payload_algebra is not None
+
+    # -- shared-scale negotiation (payload_algebra == "shared_scale") -------
+    def negotiate(self, x: jax.Array, axis_name: str):
+        """The pre-encode scale negotiation collective: return the
+        rank-replicated shared value (e.g. a psum-max of the local max
+        magnitude) that ``compress(..., shared=...)`` encodes against, or
+        None when this codec needs none. Must be called where ``axis_name``
+        is bound; the communicators hoist it BEFORE the stage-1 encode so
+        error feedback covers the single shared-scale encode exactly."""
+        return None
+
+    def negotiation_nbytes(self, world: int) -> int:
+        """Per-rank received bytes of one :meth:`negotiate` collective at
+        world size ``world`` — 0 for codecs without a negotiation. Priced
+        into the telemetry row (``negotiation_bytes``, folded like
+        ``watch_bytes``) and the tuner's wire model; the traced collective
+        itself is counted by the auditor's wire reconciliation (its scalar
+        size sits inside ``WIRE_MODEL_ATOL``)."""
+        return 0
+
+    def payload_sum_max_world(self) -> Optional[int]:
+        """Largest world size whose payload-space sum stays exact in the
+        payload dtype, or None for no codec-specific bound (float "exact"
+        payloads are covered by the generic fp16 saturation analysis,
+        flow.safe_sum_terms). Shared-scale codecs derive this from ONE
+        constant — accumulator iinfo.max // max_level — enforced at runtime
+        by the communicators' homomorphic paths and statically by the
+        numeric-safety pass and the tuner's numeric gate, mirroring
+        :func:`grace_tpu.comm.vote_exact_max_world`."""
+        return None
 
     # -- cross-step state ---------------------------------------------------
     def init_state(self, x: jax.Array) -> State:
@@ -459,9 +533,27 @@ class Communicator:
         # whole pipeline renders as anonymous XLA fusions.
         with trace_stage(STAGE_COMPENSATE):
             compensated, mem_state = memory.compensate(x, mem_state)
+        # Shared-scale negotiation, hoisted BEFORE the encode: the codec's
+        # pmax makes the scale (and thus the decode ctx) rank-identical,
+        # so payloads sum homomorphically AND error feedback covers the
+        # single shared-scale encode exactly. Skipped when the mesh axis
+        # is unbound (single-process Identity use): the codec's
+        # local-scale fallback decodes its own payload exactly there.
+        shared = None
+        if getattr(compressor, "payload_algebra", None) == "shared_scale":
+            try:
+                with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
+                    shared = compressor.negotiate(compensated,
+                                                  self.axis_name)
+            except NameError:           # unbound axis: no mesh, no peers
+                shared = None
         with trace_stage(STAGE_COMPRESS):
-            payload, ctx, comp_state = compressor.compress(
-                compensated, comp_state, rng)
+            if shared is None:
+                payload, ctx, comp_state = compressor.compress(
+                    compensated, comp_state, rng)
+            else:
+                payload, ctx, comp_state = compressor.compress(
+                    compensated, comp_state, rng, shared=shared)
         with trace_stage(STAGE_MEMORY_UPDATE):
             mem_state = memory.update(compensated, payload, ctx, compressor,
                                       mem_state)

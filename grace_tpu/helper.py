@@ -91,6 +91,17 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
     if name == "qsgd":
         return C.QSGDCompressor(quantum_num=params.get("quantum_num", 64),
                                 use_pallas=params.get("use_pallas", "auto"))
+    if name == "homoqsgd":
+        # Shared-scale homomorphic QSGD (payload_algebra='shared_scale'):
+        # quantum_num defaults to the 4-bit qsgd4 family; accum_dtype sizes
+        # the integer payload for exact W-rank sums.
+        return C.HomoQSGDCompressor(
+            quantum_num=params.get("quantum_num", 7),
+            accum_dtype=params.get("accum_dtype", "int16"))
+    if name == "countsketch":
+        return C.CountSketchCompressor(
+            compress_ratio=params.get("compress_ratio", 0.25),
+            rows=params.get("sketch_rows", 3))
     if name == "terngrad":
         return C.TernGradCompressor()
     if name == "signsgd":

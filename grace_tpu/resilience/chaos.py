@@ -131,8 +131,15 @@ class ChaosCompressor(Compressor):
         return self.inner.vote_aggregate
 
     @property
-    def summable_payload(self):  # type: ignore[override]
-        return self.inner.summable_payload
+    def payload_algebra(self):  # type: ignore[override]
+        # Delegated like supports_hop_requant (summable_payload then derives
+        # from it via the base property): the injector must ride whatever
+        # accumulation path the inner codec qualifies for — including the
+        # payload-space homomorphic summation of shared-scale/sketch codecs
+        # — or the chaos matrix could never cover the zero-requant
+        # schedules. Bitflip/drift faults then land in the SUMMED payload
+        # exactly as a corrupting wire or degrading encoder would.
+        return self.inner.payload_algebra
 
     @property
     def supports_hop_requant(self):  # type: ignore[override]
@@ -152,6 +159,18 @@ class ChaosCompressor(Compressor):
     def wire_nbytes(self, shape, dtype):
         return self.inner.wire_nbytes(shape, dtype)
 
+    # Shared-scale protocol, delegated whole: the negotiation collective,
+    # its wire price, and the overflow bound are the inner codec's — chaos
+    # only perturbs values, never the algebra's bookkeeping.
+    def negotiate(self, x: jax.Array, axis_name: str):
+        return self.inner.negotiate(x, axis_name)
+
+    def negotiation_nbytes(self, world: int) -> int:
+        return self.inner.negotiation_nbytes(world)
+
+    def payload_sum_max_world(self):
+        return self.inner.payload_sum_max_world()
+
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
         return self.inner.decompress(payload, ctx)
 
@@ -159,8 +178,8 @@ class ChaosCompressor(Compressor):
         return self.inner.aggregate(stacked)
 
     # -- faulted encode ------------------------------------------------------
-    def compress(self, x: jax.Array, state: State, rng: jax.Array
-                 ) -> tuple[Payload, Ctx, State]:
+    def compress(self, x: jax.Array, state: State, rng: jax.Array,
+                 shared=None) -> tuple[Payload, Ctx, State]:
         ckey = jax.random.fold_in(rng, self.seed)
         gate = _gate(self.rank, self.axis_name)
         if self.nan_prob:
@@ -171,7 +190,9 @@ class ChaosCompressor(Compressor):
             khit, kpos, ckey = jax.random.split(ckey, 3)
             hit = jax.random.bernoulli(khit, self.inf_prob) & gate
             x = jnp.where(hit, _implant(x, kpos, jnp.inf), x)
-        payload, ctx, new_state = self.inner.compress(x, state, rng)
+        payload, ctx, new_state = (
+            self.inner.compress(x, state, rng) if shared is None
+            else self.inner.compress(x, state, rng, shared=shared))
         if self.bitflip_prob:
             corrupted = []
             for t in payload:

@@ -21,7 +21,8 @@ records *before* the guard or the consensus audit have anything to say:
   LR spikes, loss-scale events) and over ``perf_step_times`` p50
   (step-time regression mid-run).
 * **wire-model drift** — every telemetry row's exchange bytes
-  (``wire_bytes − audit_bytes − watch_bytes``) must equal the
+  (``wire_bytes − audit_bytes − watch_bytes − negotiation_bytes``) must
+  equal the
   ``Communicator.recv_link_bytes`` total for its fallback phase; a row
   that drifts beyond :data:`~grace_tpu.core.WIRE_MODEL_RTOL`-style
   tolerance means the live schedule and the priced model disagree — the
@@ -250,7 +251,8 @@ class WatchMonitor:
         if wire is None:
             return []
         exchange = (float(wire) - float(rec.get("audit_bytes", 0.0))
-                    - float(rec.get("watch_bytes", 0.0)))
+                    - float(rec.get("watch_bytes", 0.0))
+                    - float(rec.get("negotiation_bytes", 0.0)))
         fallback = bool(rec.get("fallback"))
         expected = self._wire_expected.get(fallback)
         if expected is None:

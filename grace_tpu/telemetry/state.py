@@ -86,6 +86,16 @@ FIELDS = (
                                     # per-link split (the gather is a flat
                                     # full-axis collective, priced by the
                                     # same Topology as the exchange)
+    ("negotiation_bytes", "first"), # shared-scale negotiation collective
+                                    # cost this step (the pmax of
+                                    # payload_algebra='shared_scale'
+                                    # codecs, Compressor.negotiation_
+                                    # nbytes × compress calls): folded
+                                    # into wire_bytes AND the per-link
+                                    # split exactly like watch_bytes (a
+                                    # flat full-axis collective); zero
+                                    # for every other codec and during
+                                    # dense-fallback windows
 )
 
 FIELD_INDEX = {name: i for i, (name, _) in enumerate(FIELDS)}

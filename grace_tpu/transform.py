@@ -723,8 +723,14 @@ def grace_transform(compressor: Compressor, memory: Memory,
             return 1
 
     def _wire_plan(leaves, world):
-        """(dense, link, escape_link) logical bytes for these leaves under
-        the active fusion mode at world size ``world``. ``dense`` is the
+        """(dense, link, escape_link, negotiation) logical bytes for these
+        leaves under the active fusion mode at world size ``world``.
+        ``negotiation`` is the shared-scale negotiation collectives' cost
+        (``Compressor.negotiation_nbytes`` × one ``negotiate`` pmax per
+        compress call of the fusion plan; 0 for every other codec) —
+        surfaced as the ``negotiation_bytes`` telemetry field and folded
+        into the effective wire accounting like ``watch_bytes``, since the
+        pmax is a real flat full-axis collective. ``dense`` is the
         raw dense gradient bytes (the codec- and communicator-blind
         reference); ``link``/``escape_link`` are COMMUNICATOR-AWARE
         per-link :class:`~grace_tpu.core.LinkBytes` splits of the bytes
@@ -784,7 +790,13 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     esc_b, n_elems, world, topology=topo)
         else:
             esc_link = None
-        plan = _wire_plan_cache[(sig, world)] = (dense, link, esc_link)
+        # One negotiation collective per compress call the fusion plan
+        # issues (per bucket/leaf/group) — zero for codecs without one.
+        n_calls = sum(count for _, count
+                      in fusion_payload_structs(structs, fusion))
+        neg_b = n_calls * int(compressor.negotiation_nbytes(world))
+        plan = _wire_plan_cache[(sig, world)] = (dense, link, esc_link,
+                                                 neg_b)
         return plan
 
     def _sqsum(ls) -> jax.Array:
@@ -843,7 +855,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 "without telemetry (or restored from such a checkpoint). "
                 "Re-init the optimizer state with the telemetry-enabled "
                 "transform.")
-        dense_b, link, esc_link = _wire_plan(
+        dense_b, link, esc_link, neg_b = _wire_plan(
             leaves, _bound_axis_size(communicator.axis_name))
         comp_b, esc_b = link.total, (
             esc_link.total if esc_link is not None else None)
@@ -882,6 +894,22 @@ def grace_transform(compressor: Compressor, memory: Memory,
             eff_dcn = jnp.where(
                 fb, jnp.asarray(float(esc_link.dcn), jnp.float32),
                 jnp.asarray(float(link.dcn), jnp.float32))
+        # Shared-scale negotiation cost, folded like watch_bytes — into
+        # the scalar AND the per-link split (the pmax is a flat full-axis
+        # collective), zeroed during dense-fallback windows (the dense
+        # branch never negotiates).
+        ngb = jnp.asarray(float(neg_b), jnp.float32)
+        if escape is not None:
+            ngb = jnp.where(jnp.asarray(state.fallback, jnp.bool_),
+                            jnp.zeros((), jnp.float32), ngb)
+        if neg_b:
+            world = _bound_axis_size(communicator.axis_name)
+            topo = resolved_topology
+            eff = eff + ngb
+            if topo.crosses_dcn(world):
+                eff_dcn = eff_dcn + ngb
+            else:
+                eff_ici = eff_ici + ngb
         new_watch = state.watch
         wb = jnp.zeros((), jnp.float32)
         if watch is not None:
@@ -933,6 +961,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
             "wire_bytes_ici": eff_ici,
             "wire_bytes_dcn": eff_dcn,
             "watch_bytes": wb,
+            "negotiation_bytes": ngb,
         })
 
     def update(updates, state: GraceState, params=None):

@@ -84,6 +84,12 @@ def generated_variants(spec: TuneTopology) -> List[Candidate]:
             "topk_algorithm": "chunk", "memory": "residual"}
     qsgd4 = {"compressor": "qsgd", "quantum_num": 7, "use_pallas": False,
              "memory": "none"}
+    # Aggregation-homomorphic qsgd4 (payload_algebra='shared_scale'):
+    # requant chain 0 at ANY world, so unlike the flat qsgd ring it
+    # survives the degradation gate at pod scale — the funnel can finally
+    # rank a flat-ring codec at W=256 without the ScaleCom cliff.
+    homoq = {"compressor": "homoqsgd", "quantum_num": 7,
+             "memory": "residual"}
     out = [
         Candidate("tune-topk1pct-allgather-bucketed",
                   {**topk, "communicator": "allgather", "fusion": 1024},
@@ -98,6 +104,9 @@ def generated_variants(spec: TuneTopology) -> List[Candidate]:
                   {**qsgd4, "use_pallas": True, "communicator": "ring",
                    "fusion": 1024},
                   source="generated", tpu_only=True),
+        Candidate("tune-homoqsgd4-ring",
+                  {**homoq, "communicator": "ring", "fusion": "flat"},
+                  source="generated"),
     ]
     s = spec.slice_size
     if s is not None and spec.world > s:
@@ -110,6 +119,9 @@ def generated_variants(spec: TuneTopology) -> List[Candidate]:
                        "fusion": 1024}, source="generated"),
             Candidate(f"tune-qsgd4-hier{s}-packed",
                       {**qsgd4, "communicator": "hier", "slice_size": s,
+                       "fusion": "flat"}, source="generated"),
+            Candidate(f"tune-homoqsgd4-hier{s}",
+                      {**homoq, "communicator": "hier", "slice_size": s,
                        "fusion": "flat"}, source="generated"),
         ]
     return out
@@ -177,7 +189,8 @@ def candidate_legal(candidate: Candidate, spec: TuneTopology
     if isinstance(cm, (comm.RingAllreduce, comm.HierarchicalAllreduce)) \
             and not (summable or requant):
         return False, (f"{type(cm).__name__} keeps the payload compressed "
-                       "on every hop, which needs summable_payload or "
+                       "on every hop, which needs a payload algebra "
+                       "(exact/shared_scale/sketch — summable_payload) or "
                        f"supports_hop_requant; {type(comp).__name__} "
                        "declares neither"), grace
     if isinstance(cm, comm.HierarchicalAllreduce):

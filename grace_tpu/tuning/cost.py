@@ -153,6 +153,24 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
     topo = spec.core_topology()
     link = grace.communicator.recv_link_bytes(
         rep.wire_bytes, n, spec.world, topology=topo, vote=vote)
+    # Shared-scale negotiation collectives, priced honestly into the wire
+    # bill (Compressor.negotiation_nbytes × one negotiate per compress
+    # call of the fusion plan; 0 for every other codec). The pmax is a
+    # flat full-axis collective, so — like the watch gather — it rides ICI
+    # within one slice and DCN the moment the axis crosses slices.
+    import jax
+
+    from grace_tpu.core import LinkBytes
+    from grace_tpu.transform import fusion_payload_structs
+
+    n_calls = sum(count for _, count in fusion_payload_structs(
+        jax.tree_util.tree_leaves(model_structs), grace.fusion))
+    neg_b = n_calls * int(grace.compressor.negotiation_nbytes(spec.world))
+    if neg_b:
+        if topo.crosses_dcn(spec.world):
+            link = LinkBytes(ici=link.ici, dcn=link.dcn + neg_b)
+        else:
+            link = LinkBytes(ici=link.ici + neg_b, dcn=link.dcn)
     dense_link = Allreduce(
         axis_name=grace.communicator.axis_name).recv_link_bytes(
             dense_b, n, spec.world, topology=topo)
@@ -165,6 +183,7 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
     return {
         "payload_bytes": int(rep.wire_bytes),
         "wire_ratio": round(rep.wire_bytes / max(1, dense_b), 6),
+        "negotiation_bytes": int(neg_b),
         "ici_bytes": int(link.ici),
         "dcn_bytes": int(link.dcn),
         "wire_ms": round(wire_s(link) * 1e3, 9),

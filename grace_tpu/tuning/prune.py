@@ -113,6 +113,21 @@ def numeric_verdict(grace, spec: TuneTopology) -> Optional[str]:
                                     comm.HierarchicalAllreduce))
                     and summable and not vote)
     if sums_payload:
+        # Shared-scale integer accumulators: the codec's own
+        # payload_sum_max_world (iinfo(accum_dtype).max // max level) —
+        # the same single constant the communicators' runtime gate and
+        # flow pass 6's _shared_scale_findings enforce, evaluated at the
+        # TARGET world (an int8 homoqsgd at W=4096 dies here, statically,
+        # before anything traces).
+        if getattr(comp, "payload_algebra", None) == "shared_scale":
+            bound = comp.payload_sum_max_world()
+            if bound is not None and w > bound:
+                return (f"shared-scale payload sum of W={w} integer levels "
+                        f"exceeds payload_sum_max_world={bound} "
+                        "(iinfo(accum_dtype).max // max level) — level "
+                        "sums wrap silently; widen accum_dtype or lower "
+                        "quantum_num (the communicators raise the same "
+                        "bound on a live mesh)")
         for dt in _payload_float_dtypes(comp):
             terms = flow.safe_sum_terms(dt)
             if terms is not None and w > terms:
@@ -222,6 +237,11 @@ def static_prune(candidates: List[Candidate], spec: TuneTopology,
         if reason:
             rec.update(stage="numeric", verdict="rejected", reason=reason)
             continue
+        # Every survivor's cascaded-requant chain length rides the record:
+        # 0 is the homomorphic/payload-algebra claim the acceptance tests
+        # pin (zero re-encodes at ANY world), W−1 the flat hop-requant
+        # ring the degradation gate exists to stop.
+        rec["requant_chain"] = requant_chain_length(grace, spec)
         reason = degradation_verdict(grace, spec)
         if reason:
             rec.update(stage="degradation", verdict="rejected",

@@ -55,10 +55,12 @@ def test_registry_covers_compressor_catalog():
     catalog = {"none", "fp16", "topk", "randomk", "threshold", "qsgd",
                "terngrad", "signsgd", "signum", "efsignsgd", "onebit",
                "natural", "dgc", "powersgd", "sketch", "u8bit", "adaq",
-               "inceptionn"}
+               "inceptionn",
+               # the aggregation-homomorphic family (ISSUE 13)
+               "homoqsgd", "countsketch"}
     assert catalog <= audited
     # and the catalog names really are the exported classes
-    assert len(C.__all__) == 18
+    assert len(C.__all__) == 20
 
 
 def test_incompatible_config_traces_to_a_finding():
@@ -427,7 +429,7 @@ def test_rule_fires_on_undeclared_compressor():
         sources={"grace_tpu/compressors/shiny.py": src})
     mine = [f for f in findings if "ShinyNewCompressor" in f.message]
     assert len(mine) == 1
-    assert "summable_payload" in mine[0].message
+    assert "payload_algebra" in mine[0].message
 
 
 def test_rule_fires_on_bad_fields_reducer():

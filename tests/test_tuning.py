@@ -66,7 +66,7 @@ def test_topology_parse():
     ({"compressor": "fp16", "memory": "none",
       "communicator": "sign_allreduce"}, "vote_aggregate"),
     ({"compressor": "dgc", "compress_ratio": 0.3, "memory": "dgc",
-      "communicator": "ring"}, "summable_payload or supports_hop_requant"),
+      "communicator": "ring"}, "payload algebra"),
     ({"compressor": "signum", "momentum": 0.9, "memory": "none",
       "communicator": "twoshot"}, "stateless"),
     ({"compressor": "topk", "compress_ratio": 0.01,

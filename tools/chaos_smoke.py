@@ -42,6 +42,14 @@ exercised over the nested grouped-collective exchange — and the telemetry
 artifact's ``wire_bytes_ici``/``wire_bytes_dcn`` rows carry the mixed
 per-link split.
 
+Homomorphic scenario (ISSUE 13): ``--homo`` swaps the codec for the
+shared-scale homomorphic QSGD (``payload_algebra='shared_scale'``), so the
+fault matrix rides the zero-requant payload-space integer summation: a
+poisoned gradient NaNs the negotiated scale (pmax propagates NaN), every
+rank's single decode goes NaN, and the guard's replicated predicate must
+trip fleet-wide with rollback atomic around the hoisted negotiation.
+Combine with ``--hier`` for the slice-boundary integer-add variant.
+
 Watch scenario (ISSUE 8): ``--watch`` seeds a single-rank
 *compression-error drift* — ``ChaosCompressor(drift_scale=...)``
 attenuates one rank's payload values every step. The fault is perfectly
@@ -76,6 +84,7 @@ Usage::
     python tools/chaos_smoke.py --steps 200 --nan-prob 0.01
     python tools/chaos_smoke.py --sdc                        # + param SDC
     python tools/chaos_smoke.py --sdc --hier --slice-size 4  # hier matrix
+    python tools/chaos_smoke.py --hier --homo                # zero-requant
     python tools/chaos_smoke.py --watch --watch-rank 3       # drift watch
     python tools/chaos_smoke.py --elastic                    # kill + rejoin
     python tools/chaos_smoke.py --elastic --hier --slice-size 4  # slice kill
@@ -134,6 +143,16 @@ def main(argv=None) -> int:
     ap.add_argument("--slice-size", type=int, default=4,
                     help="with --hier: ranks per ICI slice (the 8-device "
                          "mesh then spans 8/slice_size slices)")
+    ap.add_argument("--homo", action="store_true",
+                    help="run the chaos matrix over the aggregation-"
+                         "homomorphic codec (compressor='homoqsgd', "
+                         "payload_algebra='shared_scale') instead of "
+                         "topk — the NaN implant must propagate through "
+                         "the zero-requant payload-space integer "
+                         "summation (and, with --hier, the boundary "
+                         "integer add) to trip the guard on every rank, "
+                         "and rollback must stay atomic around the "
+                         "hoisted scale negotiation")
     ap.add_argument("--watch", action="store_true",
                     help="graft-watch scenario: seed a single-rank "
                          "compression-error drift (finite — guard-blind; "
@@ -259,6 +278,21 @@ def main(argv=None) -> int:
             # summary ring sized so a flush window never wraps it
             "capacity": max(2 * args.telemetry_every // args.watch_window,
                             8)}
+    if args.homo and args.watch:
+        print("[chaos_smoke] --homo is incompatible with --watch: the "
+              "drift injector attenuates float payload lanes and the "
+              "homomorphic codec ships integer levels (drift would be a "
+              "silent no-op, voiding the scenario's claim)",
+              file=sys.stderr)
+        return 1
+    if args.homo:
+        # Homomorphic scenario (ISSUE 13): a NaN poisoned into one rank's
+        # gradient rides the negotiate pmax (NaN-max → shared scale NaN)
+        # and/or the integer level sums' decode into EVERY rank's update,
+        # so the guard's replicated predicate must trip fleet-wide and the
+        # rollback must restore GraceState around the zero-requant path.
+        grace_params.update(compressor="homoqsgd", quantum_num=7)
+        grace_params.pop("compress_ratio", None)
     if args.hier:
         # Guard + consensus over the two-level ICI×DCN exchange: the NaN
         # implant must propagate through the intra-slice ring AND the
@@ -302,7 +336,8 @@ def main(argv=None) -> int:
             argv=" ".join(sys.argv[1:]),
             nan_prob=args.nan_prob, steps=args.steps,
             fallback_after=args.fallback_after,
-            fallback_steps=args.fallback_steps))
+            fallback_steps=args.fallback_steps,
+            homo=bool(args.homo)))
         reader = TelemetryReader(sink, every=args.telemetry_every,
                                  anomaly=args.watch)
     monitor = GuardMonitor(sink=sink)

@@ -37,7 +37,7 @@ from typing import List, Optional
 PREFERRED = ["grad_norm", "update_norm", "residual_norm", "residual_max",
              "compression_error", "wire_bytes", "wire_bytes_ici",
              "wire_bytes_dcn", "dense_bytes", "fallback", "audit_bytes",
-             "watch_bytes"]
+             "watch_bytes", "negotiation_bytes"]
 
 
 def load(path: str):
