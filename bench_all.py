@@ -103,6 +103,16 @@ CONFIGS = [
      "params": {"compressor": "topk", "compress_ratio": 0.01,
                 "topk_algorithm": "chunk", "memory": "residual",
                 "communicator": "ring", "fusion": "flat"}},
+    # The FSDP exchange (ISSUE 14): one all_to_all + one all_gather,
+    # payload-space sums for exact codecs and exactly ONE requant
+    # boundary for topk — the schedule whose requant chain stays ≤1 at
+    # any W (the flat ring pays W−2), so it is the flat schedule the
+    # tuner can still rank at pod scale. Pairs with the ring/twoshot
+    # rows above for the four-way comparison at the amortizing batch.
+    {"name": "topk1pct_rscatter_bs256", "per_device_bs": 256,
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "communicator": "rscatter", "fusion": "flat"}},
     # QSGD on the ring exercises the per-hop requantization path proper
     # (decompress → accumulate → requantize each hop; topk re-selects).
     # use_pallas pinned False to match the staged qsgd row below —
@@ -331,7 +341,11 @@ TUNED_ROW_NAMES = ("none", "topk1pct", "topk1pct_hier_bs256", "qsgd_hier",
                    # the homomorphic family (ISSUE 13): the zero-requant
                    # ring/hier rows the tuner's requant-chain-0 pricing
                    # needs measured evidence for
-                   "homoqsgd4_ring_bs256", "homoqsgd4_hier_slice8")
+                   "homoqsgd4_ring_bs256", "homoqsgd4_hier_slice8",
+                   # graft-shard (ISSUE 14): the rscatter schedule now
+                   # tops the W256/slice8 static ranking — its measured
+                   # step time is the next capture's most-wanted row
+                   "topk1pct_rscatter_bs256")
 
 
 def active_configs():

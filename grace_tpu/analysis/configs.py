@@ -35,9 +35,9 @@ _NO_WIRE = tuple(p for p in PASS_NAMES if p != "wire_reconciliation")
 
 
 def _cfg(name: str, params: Dict[str, Any], *, passes=_ALL, mode="update",
-         guard=None, consensus=None) -> Dict[str, Any]:
+         guard=None, consensus=None, fsdp=None) -> Dict[str, Any]:
     return {"name": name, "params": params, "passes": passes, "mode": mode,
-            "guard": guard, "consensus": consensus}
+            "guard": guard, "consensus": consensus, "fsdp": fsdp}
 
 
 AUDIT_CONFIGS: List[Dict[str, Any]] = [
@@ -168,6 +168,66 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
                                    "compress_ratio": 0.25,
                                    "memory": "residual",
                                    "communicator": "allgather"}),
+    # -- sharded-model track (ISSUE 14): compressed reduce-scatter on 1-D
+    #    and 2-D dp×fsdp meshes. The rscatter schedule is one all_to_all
+    #    (the reduce-scatter's data movement) + one all_gather; payload-
+    #    space summation for exact/homomorphic codecs, exactly ONE requant
+    #    boundary for the rest. The fsdp=2 entries split the 8-way audit
+    #    mesh into dp=4 × fsdp=2: the tracer seeds GraceState leaves from
+    #    the 2-D partition_specs (P((dp, fsdp))), the replication analysis
+    #    runs PER AXIS, and wire_reconciliation counts the dp-axis
+    #    collectives at the dp world — proving the whole 7-pass stack
+    #    holds on 2-D configs.
+    _cfg("topk-rscatter", {"compressor": "topk", "compress_ratio": 0.3,
+                           "memory": "residual", "communicator": "rscatter",
+                           "fusion": "flat"}),
+    _cfg("fp16-rscatter-fsdp", {"compressor": "fp16", "memory": "none",
+                                "communicator": "rscatter",
+                                "fusion": "flat", "fsdp_axis": "fsdp"},
+         fsdp=2),
+    _cfg("topk-rscatter-fsdp", {"compressor": "topk",
+                                "compress_ratio": 0.3,
+                                "memory": "residual",
+                                "communicator": "rscatter",
+                                "fusion": "flat", "fsdp_axis": "fsdp"},
+         fsdp=2),
+    _cfg("homoqsgd-rscatter-fsdp", {"compressor": "homoqsgd",
+                                    "quantum_num": 7, "memory": "residual",
+                                    "communicator": "rscatter",
+                                    "fusion": "flat",
+                                    "fsdp_axis": "fsdp"}, fsdp=2),
+    # ScaleCom-style cyclic local-selection Top-K: the negotiated shared
+    # index set makes the payload exactly summable, so it rides the psum
+    # allreduce at k values/rank — and the negotiation (a k-index masked
+    # broadcast, NOT inside the scalar atol) must be carried by the wire
+    # model explicitly, which this entry pins.
+    _cfg("cyclictopk-allreduce", {"compressor": "cyclictopk",
+                                  "compress_ratio": 0.3,
+                                  "memory": "residual",
+                                  "communicator": "allreduce"}),
+    # First-class per-leaf codec routing (1-D): the wire model becomes the
+    # SUM of per-leaf prices through each leaf's own codec/communicator —
+    # wire_reconciliation audits the routed spelling end to end.
+    _cfg("routed-topk-fp16", {"compressor": "topk", "compress_ratio": 0.3,
+                              "memory": "residual",
+                              "communicator": "allgather",
+                              "route": [("b", {"compressor": "fp16",
+                                               "memory": "none",
+                                               "communicator":
+                                                   "allreduce"})]}),
+    # Routed rscatter over the 2-D mesh: the transformer-track shape —
+    # the big leaf rides sparsification through the per-shard
+    # reduce-scatter, the small leaf rides dense fp16 psum.
+    _cfg("routed-rscatter-fsdp", {"compressor": "topk",
+                                  "compress_ratio": 0.3,
+                                  "memory": "residual",
+                                  "communicator": "rscatter",
+                                  "fsdp_axis": "fsdp",
+                                  "route": [("b", {"compressor": "fp16",
+                                                   "memory": "none",
+                                                   "communicator":
+                                                       "allreduce"})]},
+         fsdp=2),
     # -- degenerate / fusion variants ---------------------------------------
     _cfg("none-identity", {"compressor": "none", "memory": "none",
                            "communicator": "identity"}),
@@ -311,6 +371,22 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
           "watch": 5, "consensus": True},
          passes=_NO_WIRE, mode="train",
          guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+    # The sharded-model resilience stack in one 2-D trace (ISSUE 14): a
+    # ROUTED rscatter exchange (per-leaf codecs, per-shard reduce-scatter
+    # over dp) under guard + consensus on the dp×fsdp mesh. The escape
+    # cond's branches differ by whole routed schedules, the guard's
+    # psum-OR and the consensus audit's fingerprint gathers all run over
+    # the dp axis only — collective_consistency must bless every
+    # replicated-predicate argument with the 2-D seeding in place
+    # (fingerprints match replicas per fsdp shard by construction).
+    _cfg("rscatter-fsdp-routed-guard-consensus",
+         {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+          "communicator": "rscatter", "fsdp_axis": "fsdp",
+          "route": [("b", {"compressor": "fp16", "memory": "none",
+                           "communicator": "allreduce"})],
+          "escape": "fp16", "consensus": True},
+         passes=_NO_WIRE, mode="train", fsdp=2,
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
 ]
 
 # -- tuner-generated variants (ISSUE 12) -----------------------------------
@@ -377,9 +453,11 @@ def audit_config(entry: Dict[str, Any], *, world: int = 8
         if entry.get("mode", "update") == "train":
             traced = trace_train_step(
                 grace, world=world, guard=entry.get("guard"),
-                consensus=entry.get("consensus"), name=name, meta=meta)
+                consensus=entry.get("consensus"), name=name, meta=meta,
+                fsdp=entry.get("fsdp"))
         else:
-            traced = trace_update(grace, world=world, name=name, meta=meta)
+            traced = trace_update(grace, world=world, name=name, meta=meta,
+                                  fsdp=entry.get("fsdp"))
     except Exception as e:                               # noqa: BLE001
         return [Finding(
             pass_name="trace", config=name, severity="error",

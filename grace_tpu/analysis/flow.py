@@ -524,12 +524,31 @@ def _codec_payload_structs(traced: TracedGraph):
     (the enumeration the executor and the wire models share), so the
     index-dtype and pack-width checks see the fused leaf sizes, not the
     raw per-parameter ones."""
+    return [(n, s) for n, s, _comp in _codec_payload_entries(traced)]
+
+
+def _codec_payload_entries(traced: TracedGraph):
+    """``(n_elems, struct, compressor)`` per compress call: the fusion
+    enumeration with the codec that actually encodes each call — for a
+    ROUTED config the compressor differs per leaf (the per-leaf route
+    table), so the index-dtype and pack-width contracts are checked
+    against each leaf's own codec."""
     from grace_tpu.transform import fusion_payload_structs
 
     grace = traced.meta.get("grace")
+    if getattr(grace, "routes", None):
+        from grace_tpu.helper import route_leaves
+
+        named = traced.meta.get("param_structs")
+        if named is None:
+            from grace_tpu.analysis.trace import default_param_structs
+            named = default_param_structs()
+        return [(int(np.prod(s.shape, dtype=np.int64)), s, comp)
+                for _p, s, comp, _m, _cm in route_leaves(grace, named)]
     structs = _param_structs(traced)
     fusion = getattr(grace, "fusion", None)
-    return [(int(np.prod(s.shape, dtype=np.int64)), s)
+    comp = getattr(grace, "compressor", None)
+    return [(int(np.prod(s.shape, dtype=np.int64)), s, comp)
             for s, _count in fusion_payload_structs(structs, fusion)]
 
 
@@ -545,11 +564,11 @@ def _index_dtype_findings(traced: TracedGraph) -> List[Finding]:
     if grace is None:
         return []
     findings: List[Finding] = []
-    for n_elems, struct in _codec_payload_structs(traced):
+    for n_elems, struct, compressor in _codec_payload_entries(traced):
         def encode(x):
             rng = jax.random.key(0)     # shape-only trace
-            payload, _, _ = grace.compressor.compress(
-                x, grace.compressor.init_state(x), rng)
+            payload, _, _ = compressor.compress(
+                x, compressor.init_state(x), rng)
             return payload
 
         try:
@@ -568,7 +587,7 @@ def _index_dtype_findings(traced: TracedGraph) -> List[Finding]:
                     pass_name="numeric_safety", config=traced.name,
                     severity="error", stage="grace/compress",
                     message=(
-                        f"{type(grace.compressor).__name__} ships a "
+                        f"{type(compressor).__name__} ships a "
                         f"{dt.name} index payload ({size} entries) for a "
                         f"{n_elems}-element fused leaf, but "
                         f"iinfo({dt.name}).max = {int(jnp.iinfo(dt).max)} "
@@ -594,11 +613,11 @@ def _packing_findings(traced: TracedGraph, pack_fns=None) -> List[Finding]:
     if grace is None:
         return []
     ships_packed = False
-    for n_elems, struct in _codec_payload_structs(traced):
+    for n_elems, struct, compressor in _codec_payload_entries(traced):
         def encode(x):
             rng = jax.random.key(0)
-            payload, _, _ = grace.compressor.compress(
-                x, grace.compressor.init_state(x), rng)
+            payload, _, _ = compressor.compress(
+                x, compressor.init_state(x), rng)
             return payload
 
         try:
@@ -677,6 +696,7 @@ def _shared_scale_findings(traced: TracedGraph) -> List[Finding]:
     # dtype; a gather decodes per rank and never sums payloads.
     if not isinstance(grace.communicator,
                       (comm.Allreduce, comm.RingAllreduce,
+                       comm.ReduceScatterAllreduce,
                        comm.HierarchicalAllreduce)):
         return []
     bound = comp.payload_sum_max_world()

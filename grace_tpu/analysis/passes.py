@@ -156,7 +156,11 @@ def _propagate_variance(jaxpr, axis_name: str,
                     and eqn.params.get("axis_index_groups") is None)
             out = False if full else any_in
         elif name in _PERMUTES or name in _ALLTOALL or name in _SCATTER:
-            out = True
+            # Rank-varying by construction over the axes they permute; a
+            # permute over a DIFFERENT mesh axis (the 2-D dp×fsdp case)
+            # moves values within this axis's groups and leaves this
+            # axis's variance as the operands had it.
+            out = axis_name in _axes_of(eqn) or any_in
         elif name == "pbroadcast":
             out = any_in
         else:
@@ -218,18 +222,31 @@ def collective_signature(jaxpr) -> Tuple:
     return tuple(sig)
 
 
+def _signature_axes(sig, mesh_axes) -> set:
+    """The mesh axes a collective signature's entries span."""
+    return {a for _name, axes, _ops, _extra in sig
+            for a in axes if a in mesh_axes}
+
+
 def pass_collective_consistency(traced: TracedGraph) -> List[Finding]:
     """Branch-divergent collective sequences under a predicate that is not
     provably replicated: the cross-rank deadlock/desync class. A cond whose
     branches differ (the dense escape hatch, the consensus audit gate) is
-    legal exactly when its predicate is replicated — every rank takes the
-    same branch, so the mismatched schedules are never both live."""
+    legal exactly when its predicate is replicated **over every mesh axis
+    the divergent collectives span** — every rank that must rendezvous
+    takes the same branch. On a 2-D dp×fsdp mesh the analysis is
+    per-axis: a predicate that varies only over fsdp may legally gate a
+    dp-axis collective (the dp peers share an fsdp index, so they agree),
+    while a predicate replicated over the *wrong* axis — e.g. psummed
+    over fsdp but still dp-varying, gating a dp collective — is condemned.
+    """
     findings: List[Finding] = []
-    var = _propagate_variance(traced.body, traced.axis_name, traced.varying)
+    axes = traced.axes
 
-    def walk(jaxpr, local_var):
-        def lookup(v):
-            return local_var.get(v, False) if _is_var(v) else False
+    def walk(jaxpr, var_maps):
+        def lookup(axis, v):
+            m = var_maps[axis]
+            return m.get(v, False) if _is_var(v) else False
 
         for eqn in jaxpr.eqns:
             name = eqn.primitive.name
@@ -238,8 +255,12 @@ def pass_collective_consistency(traced: TracedGraph) -> List[Finding]:
                             for b in eqn.params["branches"]]
                 sigs = [collective_signature(b) for b in branches]
                 if any(s != sigs[0] for s in sigs[1:]):
-                    pred_varying = lookup(eqn.invars[0])
-                    if pred_varying:
+                    spanned = set()
+                    for s in sigs:
+                        spanned |= _signature_axes(s, axes)
+                    bad = sorted(a for a in spanned
+                                 if lookup(a, eqn.invars[0]))
+                    if bad:
                         findings.append(Finding(
                             pass_name="collective_consistency",
                             config=traced.name, severity="error",
@@ -248,27 +269,34 @@ def pass_collective_consistency(traced: TracedGraph) -> List[Finding]:
                                 "lax.cond branches issue different "
                                 "collective sequences "
                                 f"({[len(s) for s in sigs]} collectives per "
-                                "branch) and the predicate is derived from "
-                                "rank-varying data — ranks can take "
-                                "different branches and deadlock/desync at "
-                                "the first mismatched collective"),
-                            details=(("world", traced.world),)))
+                                "branch) spanning mesh "
+                                f"axis(es) {sorted(spanned)} and the "
+                                "predicate is derived from data that "
+                                f"varies over {bad} — ranks that must "
+                                "rendezvous can take different branches "
+                                "and deadlock/desync at the first "
+                                "mismatched collective"),
+                            details=(("world", traced.world),
+                                     ("varying_axes", tuple(bad)))))
             elif name == "while":
                 cond_j = getattr(eqn.params.get("cond_jaxpr"), "jaxpr",
                                  eqn.params.get("cond_jaxpr"))
                 body_j = getattr(eqn.params.get("body_jaxpr"), "jaxpr",
                                  eqn.params.get("body_jaxpr"))
-                n_coll = (len(collective_signature(body_j))
-                          if body_j is not None else 0)
-                n_coll += (len(collective_signature(cond_j))
-                           if cond_j is not None else 0)
-                if n_coll and any(lookup(v) for v in eqn.invars):
+                sig = (collective_signature(body_j)
+                       if body_j is not None else ())
+                sig += (collective_signature(cond_j)
+                        if cond_j is not None else ())
+                spanned = _signature_axes(sig, axes) or (
+                    set(axes) if sig else set())
+                if sig and any(lookup(a, v) for a in spanned
+                               for v in eqn.invars):
                     findings.append(Finding(
                         pass_name="collective_consistency",
                         config=traced.name, severity="error",
                         stage=_stage_of(eqn),
                         message=(
-                            f"while loop contains {n_coll} collective(s) "
+                            f"while loop contains {len(sig)} collective(s) "
                             "but its carry includes rank-varying data — "
                             "trip counts can diverge across ranks and "
                             "strand a subset in the collective"),
@@ -276,14 +304,19 @@ def pass_collective_consistency(traced: TracedGraph) -> List[Finding]:
             # Recurse with operand variance mapped into the sub-jaxpr.
             for sub in _sub_jaxprs_of(eqn):
                 ops = eqn.invars[1:] if name == "cond" else eqn.invars
-                if len(sub.invars) == len(ops):
-                    seed = {sv: lookup(ov)
-                            for sv, ov in zip(sub.invars, ops)}
-                else:
-                    seed = {sv: True for sv in sub.invars}
-                walk(sub, _propagate_variance(sub, traced.axis_name, seed))
+                sub_maps = {}
+                for a in axes:
+                    if len(sub.invars) == len(ops):
+                        seed = {sv: lookup(a, ov)
+                                for sv, ov in zip(sub.invars, ops)}
+                    else:
+                        seed = {sv: True for sv in sub.invars}
+                    sub_maps[a] = _propagate_variance(sub, a, seed)
+                walk(sub, sub_maps)
 
-    walk(traced.body, var)
+    walk(traced.body, {a: _propagate_variance(traced.body, a,
+                                              traced.varying_for(a))
+                       for a in axes})
     return findings
 
 
@@ -324,7 +357,7 @@ def pass_bit_exactness(traced: TracedGraph) -> List[Finding]:
                 new_dtype = np.dtype(eqn.params["new_dtype"])
                 out = not np.issubdtype(new_dtype, np.floating)
             elif name in _REDUCTIONS:
-                if (traced.axis_name in _axes_of(eqn) and any(
+                if (any(a in _axes_of(eqn) for a in traced.axes) and any(
                         lookup(v) and np.issubdtype(v.aval.dtype,
                                                     np.floating)
                         for v in eqn.invars if _is_var(v))):
@@ -468,33 +501,74 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
     than the documented tolerance (:data:`grace_tpu.core.WIRE_MODEL_RTOL` /
     ``WIRE_MODEL_ATOL``). Needs ``meta['grace']`` (the config bundle) — a
     no-op on traces without a priceable model."""
-    from grace_tpu.core import WIRE_MODEL_ATOL, WIRE_MODEL_RTOL
-    from grace_tpu.transform import fusion_payload_nbytes
+    from grace_tpu.core import (WIRE_MODEL_ATOL, WIRE_MODEL_RTOL, LinkBytes,
+                                negotiation_bytes_for)
+    from grace_tpu.transform import (fusion_payload_nbytes,
+                                     fusion_payload_structs)
     from grace_tpu.analysis.trace import default_param_structs
 
     grace = traced.meta.get("grace")
     if grace is None:
         return []
-    leaves = traced.meta.get("param_structs")
-    if leaves is None:
-        leaves = list(default_param_structs().values())
-    else:
-        import jax
-        leaves = jax.tree_util.tree_leaves(leaves)
+    named = traced.meta.get("param_structs")
+    if named is None:
+        named = default_param_structs()
+    import jax
+    leaves = jax.tree_util.tree_leaves(named)
 
     counted = count_recv_bytes(traced.body, traced.axis_name, traced.world)
-    _, comp_b, n_elems = fusion_payload_nbytes(
-        grace.compressor, leaves, grace.fusion)
-    vote = bool(getattr(grace.compressor, "vote_aggregate", False))
-    model = grace.communicator.recv_wire_bytes(comp_b, n_elems,
-                                               traced.world, vote=vote)
+    routed = bool(getattr(grace, "routes", None))
+    if routed:
+        # Routed configs price as the SUM of per-leaf models through each
+        # leaf's own codec and communicator (negotiation collectives
+        # included) — the one enumeration helper.routed_recv_link_bytes
+        # owns, so telemetry, bench, and this audit can never disagree.
+        from grace_tpu.helper import routed_recv_link_bytes
+
+        def model_link_at(topo):
+            return routed_recv_link_bytes(grace, named, traced.world,
+                                          topology=topo)
+
+        model = model_link_at(None).total
+        comp_b = None
+        comm_name = "routed per-leaf model"
+    else:
+        _, comp_b, n_elems = fusion_payload_nbytes(
+            grace.compressor, leaves, grace.fusion)
+        vote = bool(getattr(grace.compressor, "vote_aggregate", False))
+        # Negotiation collectives (shared-scale pmax, cyclic Top-K's index
+        # broadcast) are real traced bytes — the model must carry them or
+        # an index negotiation larger than the atol reads as drift.
+        import numpy as _np
+        neg_b = sum(count * negotiation_bytes_for(
+            grace.compressor,
+            int(_np.prod(s.shape, dtype=_np.int64)), traced.world)
+            for s, count in fusion_payload_structs(leaves, grace.fusion))
+
+        def model_link_at(topo):
+            lb = grace.communicator.recv_link_bytes(
+                comp_b, n_elems, traced.world, topology=topo, vote=vote)
+            if not neg_b:
+                return lb
+            # Negotiations are flat full-axis collectives: ICI within one
+            # slice, DCN the moment the axis crosses — same rule the
+            # telemetry fold uses.
+            from grace_tpu.core import Topology as _T
+            t = topo if topo is not None else _T()
+            if t.crosses_dcn(traced.world):
+                return LinkBytes(ici=lb.ici, dcn=lb.dcn + neg_b)
+            return LinkBytes(ici=lb.ici + neg_b, dcn=lb.dcn)
+
+        model = grace.communicator.recv_wire_bytes(
+            comp_b, n_elems, traced.world, vote=vote) + neg_b
+        comm_name = f"{type(grace.communicator).__name__}.recv_wire_bytes"
     tol = max(WIRE_MODEL_RTOL * max(model, counted), WIRE_MODEL_ATOL)
     if abs(counted - model) > tol:
         return [Finding(
             pass_name="wire_reconciliation", config=traced.name,
             severity="error", stage="grace/exchange",
             message=(
-                f"{type(grace.communicator).__name__}.recv_wire_bytes "
+                f"{comm_name} "
                 f"models {model} B/rank/step but the traced graph moves "
                 f"{counted} B (world={traced.world}, payload={comp_b} B) — "
                 f"drift {abs(counted - model)} B exceeds the documented "
@@ -512,17 +586,16 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
     # single-slice default and a slice boundary that forces the DCN leg.
     from grace_tpu.core import Topology
     for topo in (None, Topology(slice_size=max(1, traced.world // 2))):
-        link = grace.communicator.recv_link_bytes(
-            comp_b, n_elems, traced.world, topology=topo, vote=vote)
+        link = model_link_at(topo)
         if link.ici + link.dcn != model:
             return [Finding(
                 pass_name="wire_reconciliation", config=traced.name,
                 severity="error", stage="grace/exchange",
                 message=(
-                    f"{type(grace.communicator).__name__}.recv_link_bytes "
+                    f"{comm_name} "
                     f"splits into ici={link.ici} + dcn={link.dcn} = "
                     f"{link.ici + link.dcn} B under topology "
-                    f"{topo!r}, but recv_wire_bytes models {model} B — the "
+                    f"{topo!r}, but the scalar model says {model} B — the "
                     "per-link breakdown and the scalar model must be one "
                     "implementation (override _recv_total_bytes, not the "
                     "public methods)"),
@@ -544,8 +617,7 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
                                       else max(1, traced.world // 2)))
     counted_link = count_recv_link_bytes(
         traced.body, traced.axis_name, traced.world, audit_topo)
-    model_link = grace.communicator.recv_link_bytes(
-        comp_b, n_elems, traced.world, topology=audit_topo, vote=vote)
+    model_link = model_link_at(audit_topo)
     for leg, got, want in (("ici", counted_link[0], model_link.ici),
                            ("dcn", counted_link[1], model_link.dcn)):
         tol = max(WIRE_MODEL_RTOL * max(got, want), WIRE_MODEL_ATOL)

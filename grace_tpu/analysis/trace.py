@@ -34,7 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 from grace_tpu.core import DEFAULT_AXIS
 from grace_tpu.parallel import shard_map
-from grace_tpu.transform import partition_specs
+from grace_tpu.transform import MeshSpec, partition_specs
 
 __all__ = ["TracedGraph", "abstract_mesh", "default_param_structs",
            "trace_fn", "trace_update", "trace_train_step"]
@@ -52,12 +52,20 @@ def abstract_mesh(world: int, axis_name: str = DEFAULT_AXIS):
     """An ``AbstractMesh`` across JAX versions (0.4.37 takes one
     ``((name, size), ...)`` tuple; newer releases take separate shape and
     axis-name tuples)."""
+    return abstract_mesh_nd(((axis_name, world),))
+
+
+def abstract_mesh_nd(axes: Sequence[Tuple[str, int]]):
+    """N-D ``AbstractMesh`` from ``((name, size), ...)`` pairs — the 2-D
+    dp×fsdp audit meshes trace through this."""
     from jax.sharding import AbstractMesh
 
+    axes = tuple((str(n), int(s)) for n, s in axes)
     try:
-        return AbstractMesh(((axis_name, world),))
+        return AbstractMesh(axes)
     except (TypeError, ValueError):
-        return AbstractMesh((world,), (axis_name,))
+        return AbstractMesh(tuple(s for _, s in axes),
+                            tuple(n for n, _ in axes))
 
 
 def default_param_structs() -> Dict[str, jax.ShapeDtypeStruct]:
@@ -85,15 +93,38 @@ class TracedGraph:
     name: str
     closed: Any                      # ClosedJaxpr of the whole traced fn
     body: Any                        # the shard_map body Jaxpr
-    world: int
-    axis_name: str
-    varying: Dict[Any, bool]
+    world: int                       # size of the EXCHANGE (dp) axis
+    axis_name: str                   # the exchange (dp) axis name
+    varying: Dict[Any, bool]         # dp-axis rank-variance seeds
     state_in: List[Tuple[str, Any]] = dataclasses.field(default_factory=list)
     state_out: List[Tuple[str, Any]] = dataclasses.field(default_factory=list)
     grad_in: List[Any] = dataclasses.field(default_factory=list)
     state_replicated: List[Tuple[str, Any]] = dataclasses.field(
         default_factory=list)
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # 2-D mesh support (dp×fsdp): every mesh axis name in order (empty =
+    # 1-D, (axis_name,)), per-axis sizes, and PER-AXIS rank-variance seed
+    # maps — a value can be dp-replicated yet fsdp-varying (a param
+    # shard), which is exactly what the per-axis replication dataflow of
+    # pass 1 distinguishes. Seeded from the same partition_specs contract
+    # as ``varying``.
+    mesh_axes: Tuple[str, ...] = ()
+    axis_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    varying_axes: Dict[str, Dict[Any, bool]] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def axes(self) -> Tuple[str, ...]:
+        return self.mesh_axes if self.mesh_axes else (self.axis_name,)
+
+    def varying_for(self, axis: str) -> Dict[Any, bool]:
+        """Per-axis rank-variance seeds: the recorded per-axis map when
+        the tracer produced one, the dp map for the dp axis, else the dp
+        map as the conservative stand-in (over-seeding variance can only
+        produce false positives, never silent passes)."""
+        if axis in self.varying_axes:
+            return self.varying_axes[axis]
+        return self.varying
 
 
 def _is_jaxpr_var(v) -> bool:
@@ -222,19 +253,55 @@ def _varying_mask_from_specs(state_struct, axis_name: str) -> List[bool]:
     ``partition_specs`` the real train step shards it with: leaves whose
     spec mentions the mesh axis (GraceState mem/comp/telem) vary per rank;
     everything else is replicated by the system's own sharding contract."""
-    specs = partition_specs(state_struct, axis_name)
+    return _varying_masks(state_struct,
+                          MeshSpec(dp_axis=axis_name))[axis_name]
+
+
+def _varying_masks(state_struct, mesh_spec: MeshSpec
+                   ) -> Dict[str, List[bool]]:
+    """Per-axis per-leaf rank-variance of a state pytree under a (possibly
+    2-D) :class:`MeshSpec` — the 2-D replication seeding: a GraceState
+    mem leaf (spec ``P((dp, fsdp))``) varies over BOTH axes, a replicated
+    field over neither, and the seeding stays derived from the same
+    ``partition_specs`` the real train step shards state with."""
+    specs = partition_specs(state_struct, mesh_spec)
     flat_specs = jax.tree_util.tree_leaves(
         specs, is_leaf=lambda x: isinstance(x, P))
     flat_state = jax.tree_util.tree_leaves(state_struct)
     if len(flat_specs) != len(flat_state):      # structure drifted — be safe
-        return [True] * len(flat_state)
-    return [_spec_mentions(s, axis_name) for s in flat_specs]
+        return {a: [True] * len(flat_state) for a in mesh_spec.axes}
+    return {a: [_spec_mentions(s, a) for s in flat_specs]
+            for a in mesh_spec.axes}
+
+
+def _mesh_of(grace, world: int, fsdp: Optional[int]):
+    """Resolve the audit mesh for a config: ``(mesh_spec, axes, dp)``
+    where ``axes`` is the ``((name, size), ...)`` AbstractMesh layout and
+    ``dp`` the exchange-axis size. A 2-D config (``grace.mesh`` carries
+    an fsdp axis, or ``fsdp`` passed explicitly) splits the ``world``
+    devices into ``dp = world // fsdp`` exchange groups."""
+    mesh_spec = getattr(grace, "mesh", None)
+    mesh_spec = MeshSpec.normalize(
+        mesh_spec if mesh_spec is not None
+        else grace.communicator.axis_name)
+    if mesh_spec.is_2d:
+        f = int(fsdp) if fsdp else 2
+        if world % f:
+            raise ValueError(f"fsdp={f} does not divide the audit world "
+                             f"{world}")
+        dp = world // f
+        return mesh_spec, ((mesh_spec.dp_axis, dp),
+                           (mesh_spec.fsdp_axis, f)), dp
+    return mesh_spec, ((mesh_spec.dp_axis, world),), world
 
 
 def trace_fn(fn, args: Sequence[Any], *, world: int = 8,
              axis_name: str = DEFAULT_AXIS,
              varying: Optional[Sequence[bool]] = None,
-             name: str = "fn", meta: Optional[dict] = None) -> TracedGraph:
+             name: str = "fn", meta: Optional[dict] = None,
+             mesh_axes: Optional[Sequence[Tuple[str, int]]] = None,
+             varying_axes: Optional[Dict[str, Sequence[bool]]] = None
+             ) -> TracedGraph:
     """Trace an arbitrary function inside an AbstractMesh shard_map.
 
     ``args`` are ShapeDtypeStructs (or arrays) handed to the body
@@ -242,8 +309,19 @@ def trace_fn(fn, args: Sequence[Any], *, world: int = 8,
     rank-varying (default: all varying — conservative). This is the
     low-level entry the seeded-bad-graph tests use; config audits go
     through :func:`trace_update` / :func:`trace_train_step`.
+
+    ``mesh_axes`` (``((name, size), ...)``) traces over an N-D mesh
+    instead of the 1-D ``(axis_name, world)``; the first axis is the
+    exchange axis (``TracedGraph.axis_name``/``world``).
+    ``varying_axes`` optionally gives a per-axis mask (defaults to
+    ``varying`` for every axis) — how the seeded 2-D replication tests
+    express "dp-replicated but fsdp-varying".
     """
-    am = abstract_mesh(world, axis_name)
+    layout = (tuple((str(n), int(s)) for n, s in mesh_axes)
+              if mesh_axes is not None else ((axis_name, world),))
+    axis_name = layout[0][0]
+    world = layout[0][1]
+    am = abstract_mesh_nd(layout)
     n_args = len(args)
     sm = shard_map(lambda *a: fn(*a), mesh=am,
                    in_specs=tuple(P() for _ in range(n_args)),
@@ -259,8 +337,18 @@ def trace_fn(fn, args: Sequence[Any], *, world: int = 8,
     if len(mask) != len(flat):
         raise ValueError(f"varying mask has {len(mask)} entries for "
                          f"{len(flat)} flattened arg leaves")
-    seeds = _seeds_from_positions(positions, mask, len(body.invars))
-    var_map = dict(zip(body.invars, seeds))
+    axis_masks = {a: mask for a, _ in layout}
+    if varying_axes:
+        for a, m in varying_axes.items():
+            m = list(m)
+            if len(m) != len(flat):
+                raise ValueError(
+                    f"varying_axes[{a!r}] has {len(m)} entries for "
+                    f"{len(flat)} flattened arg leaves")
+            axis_masks[a] = m
+    axis_seeds = {a: dict(zip(body.invars, _seeds_from_positions(
+        positions, m, len(body.invars))))
+        for a, m in axis_masks.items()}
     # Every outer-argument-carrying invar is a dependence root for the
     # low-level entry (the seeded-bad-graph tests treat each arg as one
     # "gradient bucket"); hoisted constants and computed values are not.
@@ -268,13 +356,16 @@ def trace_fn(fn, args: Sequence[Any], *, world: int = 8,
                 if isinstance(p, int)]
                if positions is not None else list(body.invars))
     return TracedGraph(name=name, closed=closed, body=body, world=world,
-                       axis_name=axis_name, varying=var_map,
-                       grad_in=grad_in, meta=dict(meta or {}))
+                       axis_name=axis_name, varying=axis_seeds[axis_name],
+                       grad_in=grad_in, meta=dict(meta or {}),
+                       mesh_axes=tuple(a for a, _ in layout),
+                       axis_sizes={a: s for a, s in layout},
+                       varying_axes=axis_seeds)
 
 
 def trace_update(grace, *, world: int = 8, params=None,
-                 name: str = "update", meta: Optional[dict] = None
-                 ) -> TracedGraph:
+                 name: str = "update", meta: Optional[dict] = None,
+                 fsdp: Optional[int] = None) -> TracedGraph:
     """Trace one ``grace_transform`` update (the whole 6-stage pipeline,
     escape cond and telemetry included) at world size ``world``.
 
@@ -282,8 +373,16 @@ def trace_update(grace, *, world: int = 8, params=None,
     shard_map: per-device state in, per-device gradients in, aggregated
     updates and next state out. No devices are touched — state comes from
     ``jax.eval_shape`` over ``init``.
+
+    2-D configs (``grace.mesh`` carries an fsdp axis, or ``fsdp`` given)
+    trace over a dp×fsdp AbstractMesh of the same ``world`` devices
+    (``dp = world // fsdp``): the gradients seed rank-varying over BOTH
+    axes (each device holds its own shard's local gradient), GraceState
+    leaves seed from the 2-D ``partition_specs``, and ``TracedGraph.world``
+    becomes the dp size — the span every wire/numeric model prices.
     """
     axis_name = grace.communicator.axis_name
+    mesh_spec, mesh_axes, dp = _mesh_of(grace, world, fsdp)
     tx = grace.transform(seed=0)
     params = params if params is not None else default_param_structs()
     state_struct = jax.eval_shape(tx.init, params)
@@ -294,7 +393,7 @@ def trace_update(grace, *, world: int = 8, params=None,
         updates, new_state = tx.update(grads, state, None)
         return updates, new_state
 
-    am = abstract_mesh(world, axis_name)
+    am = abstract_mesh_nd(mesh_axes)
     sm = shard_map(body, mesh=am, in_specs=(P(), P()),
                    out_specs=(P(), P()), check_vma=False)
     closed = jax.make_jaxpr(sm)(state_struct, grads_struct)
@@ -305,9 +404,12 @@ def trace_update(grace, *, world: int = 8, params=None,
         raise ValueError("no shard_map equation found in the traced update")
     inner, positions = found
 
-    state_mask = _varying_mask_from_specs(state_struct, axis_name)
-    mask = state_mask + [True] * len(grads_flat)
-    seeds = _seeds_from_positions(positions, mask, len(inner.invars))
+    masks = _varying_masks(state_struct, mesh_spec)
+    axis_seeds = {}
+    for a in mesh_spec.axes:
+        mask_a = masks[a] + [True] * len(grads_flat)
+        axis_seeds[a] = dict(zip(inner.invars, _seeds_from_positions(
+            positions, mask_a, len(inner.invars))))
     state_in = []
     grad_in = []
     if positions is not None:
@@ -323,11 +425,12 @@ def trace_update(grace, *, world: int = 8, params=None,
             state_in = []
         grad_in = [inner.invars[b] for i, b in sorted(arg_to_body.items())
                    if i >= len(state_flat)]
-    # Replicated-by-contract state leaves (spec P()): the buffers the
-    # memory-footprint pass checks for world-scaling shapes.
-    state_replicated = [(p, a) for (p, a), varies
-                        in zip(state_in, state_mask) if not varies]
-    var_map = dict(zip(inner.invars, seeds))
+    # Replicated-by-contract state leaves (spec P() — replicated over
+    # EVERY mesh axis): the buffers the memory-footprint pass checks for
+    # world-scaling shapes.
+    state_replicated = [
+        (p, a) for i, (p, a) in enumerate(state_in)
+        if not any(masks[ax][i] for ax in mesh_spec.axes)]
 
     # Body outputs are (updates..., new_state...): the state signature the
     # next step re-traces against is the trailing slice.
@@ -337,16 +440,21 @@ def trace_update(grace, *, world: int = 8, params=None,
         out_tail = inner.outvars[len(inner.outvars) - n_state:]
         state_out = [(p, v.aval)
                      for (p, _), v in zip(state_in, out_tail)]
-    return TracedGraph(name=name, closed=closed, body=inner, world=world,
-                       axis_name=axis_name, varying=var_map,
+    return TracedGraph(name=name, closed=closed, body=inner, world=dp,
+                       axis_name=axis_name,
+                       varying=axis_seeds[mesh_spec.dp_axis],
                        state_in=state_in, state_out=state_out,
                        grad_in=grad_in, state_replicated=state_replicated,
-                       meta=dict(meta or {}))
+                       meta=dict(meta or {}),
+                       mesh_axes=tuple(mesh_spec.axes),
+                       axis_sizes={n: s for n, s in mesh_axes},
+                       varying_axes=axis_seeds)
 
 
 def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
                      consensus=None, name: str = "train_step",
-                     meta: Optional[dict] = None) -> TracedGraph:
+                     meta: Optional[dict] = None,
+                     fsdp: Optional[int] = None) -> TracedGraph:
     """Trace a full ``make_train_step`` program (fwd/bwd, optimizer chain,
     optional guard and consensus audit) over an AbstractMesh.
 
@@ -360,6 +468,7 @@ def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
     from grace_tpu.transform import add_world_axis
 
     axis_name = grace.communicator.axis_name
+    mesh_spec, mesh_axes, dp = _mesh_of(grace, world, fsdp)
     params = default_param_structs()
     dim, classes = _DEFAULT_PARAMS[0][1][0], _DEFAULT_PARAMS[0][1][1]
 
@@ -372,19 +481,21 @@ def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
     tx = optax.chain(grace.transform(seed=0), optax.sgd(0.1))
     if guard is not None:
         from grace_tpu.resilience import guard_transform
-        tx = guard_transform(tx, axis_name=axis_name, **guard)
+        guard_axes = (tuple(mesh_spec.axes) if mesh_spec.is_2d
+                      else axis_name)
+        tx = guard_transform(tx, axis_name=guard_axes, **guard)
 
-    am = abstract_mesh(world, axis_name)
+    am = abstract_mesh_nd(mesh_axes)
     abstract = jax.eval_shape(tx.init, params)
-    specs = partition_specs(abstract, axis_name)
+    specs = partition_specs(abstract, mesh_spec)
     init_fn = shard_map(lambda p: add_world_axis(tx.init(p)), mesh=am,
                         in_specs=(P(),), out_specs=specs, check_vma=False)
     opt_struct = jax.eval_shape(init_fn, params)
     state_struct = TrainState(params=params, opt_state=opt_struct)
-    batch = (jax.ShapeDtypeStruct((world * 4, dim), jnp.float32),
-             jax.ShapeDtypeStruct((world * 4,), jnp.int32))
+    batch = (jax.ShapeDtypeStruct((dp * 4, dim), jnp.float32),
+             jax.ShapeDtypeStruct((dp * 4,), jnp.int32))
 
-    step = make_train_step(loss_fn, tx, mesh=am, axis_name=axis_name,
+    step = make_train_step(loss_fn, tx, mesh=am, axis_name=mesh_spec,
                            donate=False, consensus=consensus)
     closed = jax.make_jaxpr(step)(state_struct, batch)
     state_flat = jax.tree_util.tree_leaves(state_struct)
@@ -394,16 +505,22 @@ def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
         raise ValueError("no shard_map equation found in the traced step")
     inner, positions = found
 
-    mask = (_varying_mask_from_specs(state_struct, axis_name)
-            + [True] * len(batch_flat))
-    seeds = _seeds_from_positions(positions, mask, len(inner.invars))
-    var_map = dict(zip(inner.invars, seeds))
+    masks = _varying_masks(state_struct, mesh_spec)
+    axis_seeds = {}
+    for a in mesh_spec.axes:
+        mask_a = masks[a] + [True] * len(batch_flat)
+        axis_seeds[a] = dict(zip(inner.invars, _seeds_from_positions(
+            positions, mask_a, len(inner.invars))))
     grad_in = []
     if positions is not None:
         arg_to_body = {i: p for p, i in enumerate(positions)
                        if isinstance(i, int)}
         grad_in = [inner.invars[b] for i, b in sorted(arg_to_body.items())
                    if i >= len(state_flat)]
-    return TracedGraph(name=name, closed=closed, body=inner, world=world,
-                       axis_name=axis_name, varying=var_map,
-                       grad_in=grad_in, meta=dict(meta or {}))
+    return TracedGraph(name=name, closed=closed, body=inner, world=dp,
+                       axis_name=axis_name,
+                       varying=axis_seeds[mesh_spec.dp_axis],
+                       grad_in=grad_in, meta=dict(meta or {}),
+                       mesh_axes=tuple(mesh_spec.axes),
+                       axis_sizes={n: s for n, s in mesh_axes},
+                       varying_axes=axis_seeds)

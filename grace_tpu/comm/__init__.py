@@ -33,8 +33,9 @@ from grace_tpu.telemetry.scopes import (STAGE_DECOMPRESS, STAGE_EXCHANGE,
 
 __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
            "SignAllreduce", "TwoShotAllreduce", "RingAllreduce",
-           "HierarchicalAllreduce", "vote_exact_max_world",
-           "masked_broadcast", "masked_broadcast_tree"]
+           "ReduceScatterAllreduce", "HierarchicalAllreduce",
+           "vote_exact_max_world", "masked_broadcast",
+           "masked_broadcast_tree"]
 
 
 def vote_exact_max_world(vote_dtype) -> int:
@@ -715,7 +716,8 @@ class RingAllreduce(Communicator):
         shared = None
         if algebra == "shared_scale":
             with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
-                shared = compressor.negotiate(flat, self.axis_name)
+                shared = compressor.negotiate(flat, self.axis_name,
+                                              rng=rng)
 
         with trace_stage(f"{STAGE_EXCHANGE}/ring_stage1_compress"):
             payloads, ctx_arrays, treedef, static = _shard_compress(
@@ -831,6 +833,196 @@ class RingAllreduce(Communicator):
         raise TypeError("RingAllreduce re-shards the gradient before "
                         "compression; it only supports the full step() "
                         "pipeline, not a bare exchange().")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceScatterAllreduce(Communicator):
+    """One-shot compressed reduce-scatter + all-gather: the FSDP exchange.
+
+    The sharded-model track's collective (``communicator: "rscatter"``):
+    on a dp×fsdp mesh each device's gradient is already its fsdp shard's,
+    and the reduce to compress is the **per-shard reduce-scatter over the
+    dp axis**. This schedule expresses it as ONE ``all_to_all`` (the
+    reduce-scatter's data movement) plus one ``all_gather``, instead of
+    the ring's W−1 pipelined hops:
+
+    1. split the compensated (per-shard) gradient into W equal chunks
+       (``Communicator.shard_spec``); stage-1 encode shared with
+       Ring/TwoShot via ``_shard_compress`` — error feedback covers it
+       exactly, so residuals stay on the shard owner;
+    2. ``all_to_all`` the stacked chunk payloads: rank i receives every
+       dp peer's payload for chunk i (wire ≈ payload·(W−1)/W);
+    3. reduce the owned chunk — this is where the PR-13 payload algebra
+       pays off, with accumulation paths gated exactly like Ring's:
+
+       * **exact / homomorphic path** (``summable_payload``: none, fp16,
+         randomk; ``shared_scale``: homoqsgd — negotiation hoisted before
+         stage 1, sum bounded by ``payload_sum_max_world``; ``sketch``:
+         countsketch) — the W received payloads are summed **in payload
+         space** and the summed wire words themselves are gathered in
+         step 4. ZERO re-encode anywhere: unlike the ring (which also
+         sums in payload space but pays W−1 hop latencies) and unlike
+         TwoShot (which re-compresses the aggregate even for linear
+         codecs), this path is bit-identical to the one-shot
+         decode-of-the-sum at one collective's latency;
+       * **single-requant path** (``supports_hop_requant=True``: topk,
+         qsgd, signsgd) — decompress all W chunk payloads, ``aggregate``
+         (sum, or a true one-shot majority vote for sign codecs — not
+         the ring's cascaded vote), re-encode ONCE under a shared key.
+         Exactly one requant boundary regardless of W — the flat ring
+         pays W−2 intermediate requants, which is the ScaleCom
+         degradation cliff the tuner's ``MAX_REQUANT_CHAIN`` gate
+         rejects at pod scale; this schedule's requant chain is 1 at
+         any W.
+
+    4. ``all_gather`` the reduced shards, still in wire format; decode
+       all W locally and reassemble.
+
+    Wire per rank ≈ 2·payload·(W−1)/W received — same bytes as
+    Ring/TwoShot, priced through the shared per-link model (a flat
+    schedule: all-ICI within one slice, honestly all-DCN beyond it; pair
+    with ``HierarchicalAllreduce`` when the dp axis crosses slices).
+    Same enforced gates as Ring: stateless codec, wire payload, data-free
+    ctx (or a hoisted negotiation), and ``summable_payload`` or
+    ``supports_hop_requant``.
+    """
+
+    shard_parallel = True
+
+    def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
+                          world: int, vote: bool = False) -> int:
+        # all_to_all receives (W-1)/W of the stacked stage-1 payloads +
+        # all_gather receives (W-1) reduced shards of ~payload/W each.
+        return 2 * payload_nbytes * max(0, world - 1) // max(1, world)
+
+    def step(self, x: jax.Array, mem_state, comp_state,
+             memory, compressor: Compressor, rng: jax.Array):
+        if comp_state is not None:
+            raise TypeError(
+                f"ReduceScatterAllreduce requires a stateless compressor; "
+                f"{type(compressor).__name__} carries cross-step state "
+                "(init_state != None) that has no per-shard meaning — use "
+                "Allgather/Allreduce instead.")
+        algebra = _algebra(compressor)
+        homo = algebra in ("shared_scale", "sketch")
+        exact = bool(getattr(compressor, "summable_payload", False))
+        requant = bool(getattr(compressor, "supports_hop_requant", False))
+        if not (exact or requant):
+            raise TypeError(
+                f"ReduceScatterAllreduce sums or re-aggregates chunk "
+                "payloads after the all_to_all, which needs a payload "
+                "algebra (exact: none/fp16/randomk; shared_scale: "
+                "homoqsgd; sketch: countsketch — exact payload-space "
+                "summation at the owned chunk) or an opt-in to "
+                "re-encoding the aggregate once "
+                "(supports_hop_requant=True: topk/qsgd/signsgd); "
+                f"{type(compressor).__name__} declares neither — its "
+                "payload carries structure a partial sum destroys. Use "
+                "Allgather (general-purpose) instead.")
+        shape, dtype = x.shape, x.dtype
+        compensated, mem_state = memory.compensate(x, mem_state)
+        flat = compensated.reshape(-1)
+        n = flat.size
+        w, _, pad = self.shard_spec(n)              # static at trace time
+        if homo:
+            _check_payload_sum_world(compressor, w,
+                                     "ReduceScatterAllreduce")
+        chunks = jnp.pad(flat, (0, pad)).reshape(w, -1)
+
+        # Shared-scale negotiation hoisted over the WHOLE buffer before
+        # stage 1 (one pmax; every shard encodes against the identical
+        # replicated scale), exactly as Ring/Hier do.
+        shared = None
+        if algebra == "shared_scale":
+            with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
+                shared = compressor.negotiate(flat, self.axis_name,
+                                              rng=rng)
+
+        with trace_stage(f"{STAGE_EXCHANGE}/rscatter_stage1_compress"):
+            payloads, ctx_arrays, treedef, static = _shard_compress(
+                compressor, chunks, rng, "ReduceScatterAllreduce",
+                shared=shared)
+
+        # Error feedback covers the stage-1 shard encode exactly; the
+        # single requant boundary (requant path only) is downstream of it
+        # — the same contract as Ring/TwoShot.
+        view_ctx = (treedef, static, ctx_arrays, n, shape, dtype, None)
+        mem_state = memory.update(compensated, payloads, view_ctx,
+                                  _ChunkedView(compressor), mem_state)
+
+        i = lax.axis_index(self.axis_name)
+
+        def shard_ctx(c):
+            return _join_ctx(treedef, static,
+                             [jnp.take(a, c, axis=0) for a in ctx_arrays])
+
+        # The reduce-scatter's data movement: swap chunk axis for world
+        # axis — rank i now holds every dp peer's payload for chunk i.
+        with trace_stage(f"{STAGE_EXCHANGE}/rscatter_all_to_all"):
+            mine = tuple(lax.all_to_all(p, self.axis_name, 0, 0)
+                         for p in payloads)
+
+        if exact:
+            # Payload-space reduction of the owned chunk: the wire format
+            # IS the accumulator (dtype pinned so integer level sums stay
+            # in the declared accumulator width), and phase 2 gathers the
+            # summed wire words themselves — zero requant at any W.
+            owned = tuple(jnp.sum(t, axis=0, dtype=t.dtype) for t in mine)
+            if compressor.average and not homo:
+                if not all(jnp.issubdtype(t.dtype, jnp.inexact)
+                           for t in owned):
+                    raise TypeError(
+                        "ReduceScatterAllreduce with average=True requires "
+                        f"float payloads; got {[t.dtype for t in owned]} — "
+                        "integer-coded payloads cannot carry the mean "
+                        "(shared_scale/sketch algebras divide after the "
+                        "final decode instead).")
+                owned = tuple(t / w for t in owned)
+            with trace_stage(f"{STAGE_EXCHANGE}/rscatter_all_gather"):
+                gathered = tuple(
+                    lax.all_gather(t, self.axis_name, axis=0, tiled=False)
+                    for t in owned)
+            with trace_stage(STAGE_DECOMPRESS):
+                # gathered[j] is rank j's owned shard == shard j, so the
+                # stacked stage-1 ctx arrays align by construction.
+                def dec(p, arrs):
+                    return compressor.decompress(
+                        p, _join_ctx(treedef, static, list(arrs)))
+
+                out = jax.vmap(dec)(gathered, ctx_arrays)
+            if homo and compressor.average:
+                # The ONE decode already happened; int/sketch payloads
+                # cannot carry /W, so the mean divides the dense result.
+                out = out / w
+        else:
+            # Single-requant path: decode all W contributions for the
+            # owned chunk with the locally derived (data-free) ctx,
+            # aggregate — a true ONE-SHOT sum/majority vote, not the
+            # ring's cascaded one — and re-encode exactly once under a
+            # shared key every rank can decode.
+            my_ctx = shard_ctx(i)
+            stacked = jax.vmap(
+                lambda p: compressor.decompress(p, my_ctx))(mine)
+            agg = compressor.aggregate(stacked)
+            if compressor.average:
+                agg = agg / w
+            payload2, ctx2, _ = compressor.compress(
+                agg.astype(chunks.dtype), None, jax.random.fold_in(rng, w))
+            with trace_stage(f"{STAGE_EXCHANGE}/rscatter_all_gather"):
+                gathered = tuple(
+                    lax.all_gather(t, self.axis_name, axis=0, tiled=False)
+                    for t in payload2)
+            with trace_stage(STAGE_DECOMPRESS):
+                out = jax.vmap(
+                    lambda p: compressor.decompress(p, ctx2))(gathered)
+        out = out.reshape(-1)[:n].reshape(shape).astype(dtype)
+        return out, mem_state, comp_state
+
+    def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
+                 ) -> jax.Array:
+        raise TypeError("ReduceScatterAllreduce re-shards the gradient "
+                        "before compression; it only supports the full "
+                        "step() pipeline, not a bare exchange().")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -996,7 +1188,8 @@ class HierarchicalAllreduce(Communicator):
         shared = None
         if algebra == "shared_scale":
             with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
-                shared = compressor.negotiate(flat, self.axis_name)
+                shared = compressor.negotiate(flat, self.axis_name,
+                                              rng=rng)
 
         with trace_stage(f"{STAGE_EXCHANGE}/hier_stage1_compress"):
             payloads, ctx_arrays, treedef, static = _shard_compress(

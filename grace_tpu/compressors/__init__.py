@@ -9,6 +9,7 @@ functional implementation.
 from grace_tpu.compressors.none import NoneCompressor
 from grace_tpu.compressors.fp16 import FP16Compressor
 from grace_tpu.compressors.topk import TopKCompressor
+from grace_tpu.compressors.cyclictopk import CyclicTopKCompressor
 from grace_tpu.compressors.randomk import RandomKCompressor
 from grace_tpu.compressors.threshold import ThresholdCompressor
 from grace_tpu.compressors.qsgd import QSGDCompressor
@@ -27,7 +28,8 @@ from grace_tpu.compressors.adaq import AdaqCompressor
 from grace_tpu.compressors.inceptionn import InceptionNCompressor
 
 __all__ = [
-    "NoneCompressor", "FP16Compressor", "TopKCompressor", "RandomKCompressor",
+    "NoneCompressor", "FP16Compressor", "TopKCompressor",
+    "CyclicTopKCompressor", "RandomKCompressor",
     "ThresholdCompressor", "QSGDCompressor", "HomoQSGDCompressor",
     "CountSketchCompressor", "TernGradCompressor",
     "SignSGDCompressor", "SignumCompressor", "EFSignSGDCompressor",

@@ -1,0 +1,116 @@
+"""Cyclic local-selection Top-K — ScaleCom's scalable sparsification.
+
+Per-rank independent Top-K degrades at scale twice over (ScaleCom,
+arXiv:2104.11125 — PAPERS.md): the union of W ranks' index sets grows
+toward W·k (the gather cost cliff), and the aggregate keeps shrinking
+toward the intersection of everyone's preferences. ScaleCom's CLT-k fix:
+each step ONE rank's *local* selection decides the index set for the
+whole fleet, and the deciding rank cycles — error feedback re-injects
+every other rank's unselected mass, so over a cycle all ranks'
+preferences are heard, while the per-step index set stays exactly k.
+
+Mapped onto this repo's negotiation machinery (the PR-13 hoist):
+
+1. **negotiate** — the leader for this (step, leaf) is derived from the
+   replicated rng key (rank-identical by the transform's rng contract;
+   a pseudo-random rotation with the same coverage as ScaleCom's
+   round-robin, needing no step counter in a stateless codec). The
+   leader's local top-k indices are :func:`~grace_tpu.comm.
+   masked_broadcast` to every rank — ONE small integer collective,
+   priced via :meth:`negotiation_nbytes`.
+2. **encode** — every rank ships its values AT THE SHARED INDICES.
+3. **aggregate** — because the index set is rank-identical, payloads sum
+   **exactly in payload space** (``payload_algebra='exact'``): Allreduce
+   psums k values instead of gathering W·k, and no schedule ever pays a
+   requant. This is the property per-rank Top-K structurally cannot
+   have (its per-rank index sets are why ``topk`` declares no algebra).
+
+Residual coverage: a non-leader's large coordinates that the leader
+missed land in error-feedback memory verbatim and re-compete next step —
+ScaleCom §III's convergence argument. The codec is stateless; without a
+bound mesh axis (Identity/single-process) it falls back to local
+selection, which decodes its own payload exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grace_tpu.core import Compressor, Ctx, Payload, State, axis_size
+from grace_tpu.compressors.topk import static_k
+from grace_tpu.ops.sparse import scatter_dense
+
+
+@dataclasses.dataclass(frozen=True)
+class CyclicTopKCompressor(Compressor):
+    # The negotiated shared index set is exactly what makes the payload
+    # linear: sum-of-payloads decodes to sum-of-decodes bit-for-bit (same
+    # scatter coordinates on every rank), so every payload-space schedule
+    # (Allreduce psum, ring/rscatter hop adds) is exact. Per-rank topk
+    # cannot claim this; the negotiation is the price of the algebra.
+    payload_algebra = "exact"
+    # Re-selecting over a partial sum would change the index set mid-
+    # schedule and desync it from the negotiated ctx — the exact payload
+    # algebra already gives every hop-pipelined schedule a lossless path.
+    supports_hop_requant = False
+    # Non-scale negotiation (a leader's index set): communicators hoist
+    # negotiate() before the stage-1 encode via core.needs_negotiation.
+    negotiates = True
+
+    compress_ratio: float = 0.01
+
+    def negotiate(self, x: jax.Array, axis_name: str, rng=None):
+        """Leader election + index broadcast: the rank picked from the
+        replicated ``rng`` computes local top-k indices; every rank
+        receives them bit-exactly (integer masked-broadcast psum)."""
+        from grace_tpu.comm import masked_broadcast
+
+        w = axis_size(axis_name)
+        flat = x.reshape(-1)
+        k = static_k(flat.size, self.compress_ratio)
+        if rng is None:
+            leader = jnp.zeros((), jnp.int32)
+        else:
+            # Replicated key -> replicated leader; rotates per (step,
+            # leaf) with ScaleCom-round-robin coverage in distribution.
+            leader = jax.random.randint(jax.random.fold_in(rng, 0x5ca1e),
+                                        (), 0, w, dtype=jnp.int32)
+        _, idx = lax.top_k(jnp.abs(flat), k)
+        return masked_broadcast(idx.astype(jnp.int32), leader, axis_name)
+
+    def negotiation_nbytes_for(self, n_elems: int, world: int) -> int:
+        """Per-rank received bytes of one index broadcast for an
+        ``n_elems``-element leaf — the leaf-aware spelling the telemetry
+        wire plan and the auditor's model use."""
+        k = static_k(int(n_elems), self.compress_ratio)
+        return 2 * 4 * k * max(0, world - 1) // max(1, world)
+
+    def compress(self, x: jax.Array, state: State, rng: jax.Array,
+                 shared: jax.Array | None = None
+                 ) -> tuple[Payload, Ctx, State]:
+        """Ship values at the negotiated indices (``shared``); fall back
+        to rank-local selection when no negotiation ran (Identity /
+        single-process — decodes this rank's own payload exactly, it
+        just isn't the shared-index algebra)."""
+        shape, numel = x.shape, x.size
+        flat = x.reshape(-1)
+        k = static_k(numel, self.compress_ratio)
+        if shared is None:
+            _, idx = lax.top_k(jnp.abs(flat), k)
+            idx = idx.astype(jnp.int32)
+        else:
+            idx = shared.astype(jnp.int32)
+        values = flat[idx]
+        # idx rides in ctx, not the payload: it is rank-identical (the
+        # whole point of the negotiation), so payload-space sums touch
+        # values only and decode against one shared scatter map.
+        return (values,), (idx, numel, shape, x.dtype), state
+
+    def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
+        (values,) = payload
+        idx, numel, shape, dtype = ctx
+        return scatter_dense(values.astype(dtype), idx, numel, shape)

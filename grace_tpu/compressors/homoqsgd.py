@@ -83,7 +83,8 @@ class HomoQSGDCompressor(Compressor):
             // self.quantum_num
 
     # -- negotiation ---------------------------------------------------------
-    def negotiate(self, x: jax.Array, axis_name: str) -> jax.Array:
+    def negotiate(self, x: jax.Array, axis_name: str,
+                  rng=None) -> jax.Array:
         """The shared-scale collective: pmax of the local max magnitude
         over the axis. Replicated by construction — every rank computes
         the identical scale, which is what makes the level payloads (and

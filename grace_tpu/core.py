@@ -90,6 +90,32 @@ WIRE_MODEL_RTOL = 0.10
 WIRE_MODEL_ATOL = 256
 
 
+def needs_negotiation(compressor) -> bool:
+    """Whether the communicators must hoist ``compressor.negotiate``
+    BEFORE the stage-1 encode: every ``shared_scale`` codec (the scale IS
+    the negotiation), plus codecs that declare ``negotiates = True`` for a
+    non-scale shared object (cyclic Top-K's leader index set). One
+    predicate so core.step, Ring, Hier, and ReduceScatter can never
+    disagree about who negotiates."""
+    return (getattr(compressor, "payload_algebra", None) == "shared_scale"
+            or getattr(compressor, "negotiates", False))
+
+
+def negotiation_bytes_for(compressor, n_elems: int, world: int) -> int:
+    """Per-rank received bytes of one negotiation collective for an
+    ``n_elems``-element compress call: the codec's leaf-aware
+    ``negotiation_nbytes_for`` when it declares one (cyclic Top-K's index
+    broadcast scales with k), else the world-only
+    ``negotiation_nbytes`` (homoqsgd's scalar pmax). ONE accessor shared
+    by the telemetry wire plan, the tuner's pricing, and the auditor's
+    wire model so the three can never price the same collective
+    differently."""
+    fn = getattr(compressor, "negotiation_nbytes_for", None)
+    if fn is not None:
+        return int(fn(int(n_elems), world))
+    return int(compressor.negotiation_nbytes(world))
+
+
 class LinkBytes(NamedTuple):
     """Per-rank received bytes split by the link class they arrive over.
 
@@ -308,14 +334,25 @@ class Compressor:
         call site already reads; a codec never declares it directly."""
         return self.payload_algebra is not None
 
-    # -- shared-scale negotiation (payload_algebra == "shared_scale") -------
-    def negotiate(self, x: jax.Array, axis_name: str):
-        """The pre-encode scale negotiation collective: return the
-        rank-replicated shared value (e.g. a psum-max of the local max
-        magnitude) that ``compress(..., shared=...)`` encodes against, or
-        None when this codec needs none. Must be called where ``axis_name``
-        is bound; the communicators hoist it BEFORE the stage-1 encode so
-        error feedback covers the single shared-scale encode exactly."""
+    # True iff the codec runs a pre-encode negotiation collective even
+    # though its payload algebra is not "shared_scale" (which implies one):
+    # e.g. the ScaleCom-style cyclic local-selection Top-K negotiates a
+    # shared INDEX SET (a leader's local selection, broadcast) rather than
+    # a scale. Gated through needs_negotiation() so every communicator
+    # hoists the same way.
+    negotiates = False
+
+    # -- pre-encode negotiation (shared scale / shared selection) -----------
+    def negotiate(self, x: jax.Array, axis_name: str, rng=None):
+        """The pre-encode negotiation collective: return the
+        rank-replicated shared value (a pmax'd scale, a leader's
+        broadcast index set) that ``compress(..., shared=...)`` encodes
+        against, or None when this codec needs none. Must be called where
+        ``axis_name`` is bound; the communicators hoist it BEFORE the
+        stage-1 encode so error feedback covers the single negotiated
+        encode exactly. ``rng`` is the replicated per-(step, leaf) key —
+        rank-identical by the transform's rng contract — for negotiations
+        that rotate a leader across steps (cyclic Top-K)."""
         return None
 
     def negotiation_nbytes(self, world: int) -> int:
@@ -533,18 +570,20 @@ class Communicator:
         # whole pipeline renders as anonymous XLA fusions.
         with trace_stage(STAGE_COMPENSATE):
             compensated, mem_state = memory.compensate(x, mem_state)
-        # Shared-scale negotiation, hoisted BEFORE the encode: the codec's
-        # pmax makes the scale (and thus the decode ctx) rank-identical,
-        # so payloads sum homomorphically AND error feedback covers the
-        # single shared-scale encode exactly. Skipped when the mesh axis
-        # is unbound (single-process Identity use): the codec's
-        # local-scale fallback decodes its own payload exactly there.
+        # Pre-encode negotiation, hoisted BEFORE the encode: the codec's
+        # collective (shared-scale pmax, cyclic Top-K's leader index
+        # broadcast) makes the shared object — and thus the decode ctx —
+        # rank-identical, so payloads sum homomorphically AND error
+        # feedback covers the single negotiated encode exactly. Skipped
+        # when the mesh axis is unbound (single-process Identity use):
+        # the codec's local fallback decodes its own payload exactly
+        # there.
         shared = None
-        if getattr(compressor, "payload_algebra", None) == "shared_scale":
+        if needs_negotiation(compressor):
             try:
                 with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
                     shared = compressor.negotiate(compensated,
-                                                  self.axis_name)
+                                                  self.axis_name, rng=rng)
             except NameError:           # unbound axis: no mesh, no peers
                 shared = None
         with trace_stage(STAGE_COMPRESS):

@@ -18,16 +18,18 @@ hyperparameters (``compress_ratio``, ``quantum_num``, ``threshold``,
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
 import optax
 
 from grace_tpu import comm
 from grace_tpu import compressors as C
 from grace_tpu import memories as M
-from grace_tpu.core import (DEFAULT_AXIS, Communicator, Compressor, Memory,
-                            Topology)
-from grace_tpu.transform import grace_transform
+from grace_tpu.core import (DEFAULT_AXIS, Communicator, Compressor,
+                            LinkBytes, Memory, Topology,
+                            negotiation_bytes_for)
+from grace_tpu.transform import MeshSpec, grace_transform, leaf_path_str, \
+    normalize_routes, route_for
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,6 +59,15 @@ class Grace:
                            # graft-watch in-graph cross-rank health
                            # aggregation (grace_tpu.telemetry.aggregate);
                            # requires telemetry.
+    mesh: Any = None       # None | axis str | transform.MeshSpec: the mesh
+                           # layout (dp axis + optional fsdp axis for the
+                           # sharded-model track). Set from
+                           # params["fsdp_axis"] by grace_from_params.
+    routes: Tuple = ()     # normalized ((pattern, compressor, memory,
+                           # communicator), ...): first-class per-leaf
+                           # codec routing — embeddings ride aggressive
+                           # sparsification while LayerNorm/bias leaves
+                           # ride dense/fp16. Set from params["route"].
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,
@@ -65,7 +76,9 @@ class Grace:
                                telemetry=self.telemetry,
                                consensus=self.consensus,
                                topology=self.topology,
-                               watch=self.watch)
+                               watch=self.watch,
+                               mesh=self.mesh,
+                               routes=self.routes or None)
 
 
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
@@ -75,6 +88,12 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
         return C.NoneCompressor()
     if name in ("fp16", "bf16", "bfloat16"):
         return C.FP16Compressor(dtype="float16" if name == "fp16" else "bfloat16")
+    if name == "cyclictopk":
+        # ScaleCom-style cyclic local-selection Top-K: a rotating leader's
+        # local index set is negotiated fleet-wide, so the payload is
+        # exactly summable (payload_algebra='exact') — the large-W fix for
+        # per-rank topk's degradation cliff.
+        return C.CyclicTopKCompressor(compress_ratio=ratio)
     if name == "topk":
         return C.TopKCompressor(
             compress_ratio=ratio,
@@ -169,6 +188,12 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
             stage2_feedback=bool(params.get("stage2_feedback", False)))
     if name in ("ring", "ring_allreduce"):
         return comm.RingAllreduce(axis_name=axis)
+    if name in ("rscatter", "reduce_scatter", "rscatter_allreduce"):
+        # Compressed reduce-scatter + all-gather over the dp axis: the
+        # sharded-model (FSDP) exchange — one all_to_all instead of the
+        # ring's W−1 hops; payload-space sums for exact/homomorphic
+        # codecs, exactly ONE requant boundary for the rest.
+        return comm.ReduceScatterAllreduce(axis_name=axis)
     if name in ("hier", "hierarchical", "hier_allreduce"):
         # slice_size: ranks [k*S, (k+1)*S) form one ICI slice; the
         # two-level ICI×DCN schedule (intra-slice ring reduce-scatter,
@@ -192,6 +217,28 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
     extension with no reference analog in the params dict — Horovod's fusion
     buffer was a buried env knob (HOROVOD_FUSION_THRESHOLD); here it is
     first-class.
+
+    ``fsdp_axis`` (grace-tpu extension): name of the mesh axis params and
+    optimizer state shard over — declares the 2-D dp×fsdp sharded-model
+    layout (:class:`grace_tpu.transform.MeshSpec`); the communicator's
+    exchange stays the per-shard reduce over ``axis_name``.
+
+    ``route`` (grace-tpu extension): ``[(pattern, overrides), ...]`` —
+    first-class per-leaf codec routing. Each ``overrides`` dict is merged
+    over this config's own params (minus the route itself) and built into
+    a full sub-triad; ``pattern`` is an fnmatch glob matched against the
+    gradient leaf's ``"/"``-joined tree path. First match wins; unmatched
+    leaves ride the base triad. Example — transformer routing::
+
+        {"compressor": "topk", "compress_ratio": 0.01,
+         "topk_algorithm": "chunk", "memory": "residual",
+         "communicator": "rscatter",
+         "route": [("*ln*", {"compressor": "fp16",
+                             "communicator": "allreduce",
+                             "memory": "none"}),
+                   ("*bias*", {"compressor": "fp16",
+                               "communicator": "allreduce",
+                               "memory": "none"})]}
     """
     axis = params.get("axis_name", DEFAULT_AXIS)
     fusion = params.get("fusion")
@@ -212,11 +259,30 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
     # the Topology it implies. Without it the layout is auto-detected
     # (Topology.detect) — single slice on CPU/simulated meshes.
     slice_size = params.get("slice_size")
+    fsdp_axis = params.get("fsdp_axis")
+    mesh = (MeshSpec(dp_axis=axis, fsdp_axis=str(fsdp_axis))
+            if fsdp_axis else None)
+    routes: Tuple = ()
+    if params.get("route"):
+        sub_entries = []
+        for entry in params["route"]:
+            pattern, overrides = entry
+            merged = {k: v for k, v in params.items() if k != "route"}
+            # Route overrides REPLACE the base codec selection wholesale:
+            # inheriting e.g. the base compress_ratio under an fp16
+            # override is fine, but a leftover base "compressor" key must
+            # not survive an override that names its own.
+            merged.update(dict(overrides))
+            sub_entries.append((str(pattern), grace_from_params(merged)))
+        routes = normalize_routes(
+            sub_entries, _build_communicator(params, axis))
     return Grace(compressor=_build_compressor(params, axis),
                  memory=_build_memory(params, axis),
                  communicator=_build_communicator(params, axis),
                  fusion=fusion,
                  escape=escape,
+                 mesh=mesh,
+                 routes=routes,
                  topology=(Topology(slice_size=int(slice_size))
                            if slice_size else None),
                  # True | ring capacity | {"capacity": ..,
@@ -228,3 +294,52 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
                  # True | window | {"window": .., "capacity": ..} — see
                  # grace_transform(watch=) / telemetry.aggregate
                  watch=params.get("watch"))
+
+
+def route_leaves(grace: Grace, tree):
+    """Per-leaf route resolution for a gradient/param pytree:
+    ``[(path, struct, compressor, memory, communicator), ...]`` in
+    flatten order — the one enumeration the routed wire models (telemetry,
+    bench projections, the static auditor's reconciliation) share."""
+    import jax
+    import jax.numpy as jnp
+
+    base = (grace.compressor, grace.memory, grace.communicator)
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        p = leaf_path_str(path)
+        comp, mem, cm = route_for(grace.routes or (), p, base)
+        out.append((p, jax.ShapeDtypeStruct(tuple(jnp.shape(leaf)),
+                                            jnp.result_type(leaf)),
+                    comp, mem, cm))
+    return out
+
+
+def routed_recv_link_bytes(grace: Grace, tree, world: int,
+                           topology=None) -> LinkBytes:
+    """Per-rank received bytes of one routed step, split by link class:
+    the SUM of per-leaf prices through each leaf's own codec and
+    communicator (negotiation collectives included) — the routed spelling
+    of ``Communicator.recv_link_bytes`` that bench projections and the
+    auditor's wire pass reconcile against. Works for unrouted bundles too
+    (every leaf resolves to the base triad), so callers need no special
+    case."""
+    from grace_tpu.utils.metrics import payload_nbytes
+    import numpy as np
+
+    ici = dcn = 0
+    for _p, s, comp, _mem, cm in route_leaves(grace, tree):
+        ne = int(np.prod(s.shape, dtype=np.int64))
+        vote = bool(getattr(comp, "vote_aggregate", False))
+        lb = cm.recv_link_bytes(payload_nbytes(comp, s), ne, world,
+                                topology=topology, vote=vote)
+        neg = negotiation_bytes_for(comp, ne, world)
+        topo = topology if topology is not None else Topology()
+        if neg and topo.crosses_dcn(world):
+            lb = LinkBytes(ici=lb.ici, dcn=lb.dcn + neg)
+        elif neg:
+            lb = LinkBytes(ici=lb.ici + neg, dcn=lb.dcn)
+        ici += lb.ici
+        dcn += lb.dcn
+    return LinkBytes(ici=ici, dcn=dcn)

@@ -71,9 +71,16 @@ def guarded_chain(grace, *txs: optax.GradientTransformation,
     control.
     """
     inner = optax.chain(grace.transform(seed=seed), *txs)
+    # On a 2-D dp×fsdp mesh the bad-step OR must span the WHOLE mesh (a
+    # tuple of axis names — lax.psum reduces over both): per-rank state
+    # scans can disagree across fsdp shards too, and the fallback window
+    # must open fleet-wide or the per-shard exchanges desync.
+    mesh = getattr(grace, "mesh", None)
+    axes = (tuple(mesh.axes) if getattr(mesh, "is_2d", False)
+            else grace.communicator.axis_name)
     return guard_transform(inner,
                            max_norm=max_norm,
                            check_state=check_state,
                            fallback_after=fallback_after,
                            fallback_steps=fallback_steps,
-                           axis_name=grace.communicator.axis_name)
+                           axis_name=axes)

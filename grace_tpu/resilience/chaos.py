@@ -159,11 +159,17 @@ class ChaosCompressor(Compressor):
     def wire_nbytes(self, shape, dtype):
         return self.inner.wire_nbytes(shape, dtype)
 
+    @property
+    def negotiates(self):  # type: ignore[override]
+        # Delegated like payload_algebra: a routed/negotiating codec under
+        # chaos must still get its pre-encode collective hoisted.
+        return getattr(self.inner, "negotiates", False)
+
     # Shared-scale protocol, delegated whole: the negotiation collective,
     # its wire price, and the overflow bound are the inner codec's — chaos
     # only perturbs values, never the algebra's bookkeeping.
-    def negotiate(self, x: jax.Array, axis_name: str):
-        return self.inner.negotiate(x, axis_name)
+    def negotiate(self, x: jax.Array, axis_name: str, rng=None):
+        return self.inner.negotiate(x, axis_name, rng=rng)
 
     def negotiation_nbytes(self, world: int) -> int:
         return self.inner.negotiation_nbytes(world)
@@ -344,7 +350,15 @@ class ChaosParams:
                 f"ChaosParams(rank={self.rank}) but the target leaf has "
                 f"only {len(shards)} addressable shards — SDC injection "
                 "needs a replicated leaf with one shard per device.")
-        pos = int(rng.integers(arr.size))
+        # Position drawn within the target device's OWN buffer: for a
+        # replicated leaf that is the whole array (the historical
+        # behavior, byte-identical — same bound, same rng stream); for an
+        # fsdp-SHARDED leaf (2-D mesh) the buffer is that device's shard,
+        # so the flip corrupts one rank's copy of the shard its dp peers
+        # also hold — exactly the divergence the per-fsdp-shard consensus
+        # audit must catch.
+        pos = int(rng.integers(int(np.prod(shards[self.rank].data.shape,
+                                           dtype=np.int64))))
         bit = int(rng.integers(np.dtype(arr.dtype).itemsize * 8))
         uint = np.dtype(f"uint{np.dtype(arr.dtype).itemsize * 8}")
         bufs = []

@@ -42,7 +42,7 @@ from grace_tpu.parallel import replicated, shard_map
 from grace_tpu.telemetry.scopes import (STAGE_APPLY, STAGE_CONSENSUS,
                                         STAGE_FWD_BWD, STAGE_OPTIMIZER,
                                         trace_stage)
-from grace_tpu.transform import (add_world_axis, partition_specs,
+from grace_tpu.transform import (MeshSpec, add_world_axis, partition_specs,
                                  strip_world_axis)
 
 __all__ = ["TrainState", "StatefulTrainState", "make_train_step",
@@ -62,20 +62,40 @@ class StatefulTrainState(NamedTuple):
     opt_state: Any
 
 
-def _lazy_sharded_step(device_step, mesh: Mesh, axis_name: str, donate: bool):
+def _apply_param_specs(specs, state, param_specs):
+    """Substitute the caller's fsdp param sharding into the derived spec
+    pytree: the ``params`` field of a (Stateful)TrainState gets
+    ``param_specs`` (a spec pytree matching params, or one PartitionSpec
+    for every leaf); everything else keeps the ``partition_specs``
+    contract."""
+    if param_specs is None:
+        return specs
+    if isinstance(param_specs, P):
+        param_specs = jax.tree_util.tree_map(lambda _: param_specs,
+                                             state.params)
+    return specs._replace(params=param_specs)
+
+
+def _lazy_sharded_step(device_step, mesh: Mesh, axis_name, donate: bool,
+                       param_specs=None):
     """jit(shard_map(device_step)) with state specs derived from the first
     state actually passed in — the spec pytree depends on where GraceState
-    nodes sit inside the (optimizer-dependent) state structure."""
+    nodes sit inside the (optimizer-dependent) state structure.
+    ``axis_name`` may be a :class:`~grace_tpu.transform.MeshSpec`; the
+    batch shards over its dp axis and ``param_specs`` (sharded-model
+    track) overrides the params portion of the state specs."""
+    mesh_spec = MeshSpec.normalize(axis_name)
     cache = {}
 
     def step(state, batch):
         key = jax.tree_util.tree_structure(state)
         fn = cache.get(key)
         if fn is None:
-            specs = partition_specs(state, axis_name)
+            specs = _apply_param_specs(
+                partition_specs(state, mesh_spec), state, param_specs)
             sharded = shard_map(
                 device_step, mesh=mesh,
-                in_specs=(specs, P(axis_name)),
+                in_specs=(specs, P(mesh_spec.dp_axis)),
                 out_specs=(specs, P()),
                 check_vma=False)
             fn = jax.jit(sharded, donate_argnums=(0,) if donate else ())
@@ -91,10 +111,11 @@ def _lazy_sharded_step(device_step, mesh: Mesh, axis_name: str, donate: bool):
 def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
                     optimizer: optax.GradientTransformation,
                     mesh: Mesh,
-                    axis_name: str = DEFAULT_AXIS,
+                    axis_name=DEFAULT_AXIS,
                     donate: bool = True,
                     remat: bool = False,
-                    consensus=None):
+                    consensus=None,
+                    param_specs=None):
     """Build ``step(state, batch) -> (state, loss)``.
 
     ``loss_fn(params, batch)`` must return the mean loss over its *local*
@@ -116,10 +137,21 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
     the same jitted shard_map step. Requires the grace transform to have
     been built with ``consensus=...`` so ``GraceState`` carries the
     ``AuditState`` (clear in-graph error otherwise).
+
+    ``axis_name`` may be a :class:`~grace_tpu.transform.MeshSpec` for the
+    sharded-model (dp×fsdp) track; pass ``param_specs`` (a PartitionSpec
+    pytree matching params, or one spec for every leaf) to shard params —
+    and the param-shaped slots the consensus audit repairs — over the
+    fsdp axis. ``loss_fn`` then sees its *local* param shards and owns
+    any cross-shard collectives (tensor-parallel style, over
+    ``mesh_spec.fsdp_axis``); the consensus audit and the loss pmean stay
+    on the dp axis, so fingerprints match replicas per fsdp shard.
     """
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
     consensus = _normalize_consensus(consensus)
+    mesh_spec = MeshSpec.normalize(axis_name)
+    dp = mesh_spec.dp_axis
 
     def device_step(state: TrainState, batch):
         opt_state = strip_world_axis(state.opt_state)
@@ -136,11 +168,12 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
         if consensus is not None:
             with trace_stage(STAGE_CONSENSUS):
                 params, opt_state = _consensus_step(
-                    (params, opt_state), consensus, axis_name)
-        loss = lax.pmean(loss, axis_name)
+                    (params, opt_state), consensus, dp)
+        loss = lax.pmean(loss, dp)
         return TrainState(params, add_world_axis(opt_state)), loss
 
-    return _lazy_sharded_step(device_step, mesh, axis_name, donate)
+    return _lazy_sharded_step(device_step, mesh, mesh_spec, donate,
+                              param_specs=param_specs)
 
 
 def _normalize_consensus(consensus):
@@ -161,11 +194,12 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
                                                Tuple[jax.Array, Any]],
                              optimizer: optax.GradientTransformation,
                              mesh: Mesh,
-                             axis_name: str = DEFAULT_AXIS,
+                             axis_name=DEFAULT_AXIS,
                              donate: bool = True,
                              sync_model_state: bool = True,
                              remat: bool = False,
-                             consensus=None):
+                             consensus=None,
+                             param_specs=None):
     """Like :func:`make_train_step` for models with non-param state (BN stats).
 
     ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
@@ -180,6 +214,8 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
     consensus = _normalize_consensus(consensus)
+    mesh_spec = MeshSpec.normalize(axis_name)
+    dp = mesh_spec.dp_axis
 
     def device_step(state: StatefulTrainState, batch):
         opt_state = strip_world_axis(state.opt_state)
@@ -189,7 +225,7 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
                 state.params, state.model_state, batch)
         if sync_model_state:
             mstate = jax.tree_util.tree_map(
-                lambda m: lax.pmean(m, axis_name), mstate)
+                lambda m: lax.pmean(m, dp), mstate)
         with trace_stage(STAGE_OPTIMIZER):
             updates, opt_state = optimizer.update(grads, opt_state,
                                                   state.params)
@@ -198,29 +234,58 @@ def make_stateful_train_step(loss_fn: Callable[[Any, Any, Any],
         if consensus is not None:
             with trace_stage(STAGE_CONSENSUS):
                 params, mstate, opt_state = _consensus_step(
-                    (params, mstate, opt_state), consensus, axis_name)
-        loss = lax.pmean(loss, axis_name)
+                    (params, mstate, opt_state), consensus, dp)
+        loss = lax.pmean(loss, dp)
         return (StatefulTrainState(params, mstate, add_world_axis(opt_state)),
                 loss)
 
-    return _lazy_sharded_step(device_step, mesh, axis_name, donate)
+    return _lazy_sharded_step(device_step, mesh, mesh_spec, donate,
+                              param_specs=param_specs)
 
 
 def init_opt_state(params: Any, optimizer: optax.GradientTransformation,
-                   mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> Any:
+                   mesh: Mesh, axis_name=DEFAULT_AXIS,
+                   param_specs=None) -> Any:
     """Optimizer state in the global layout: grace mem/comp leaves get their
-    leading world axis, sharded over ``axis_name``; the rest is replicated.
+    leading world axis, sharded over the mesh (``P(dp)``, or
+    ``P((dp, fsdp))`` on a 2-D :class:`~grace_tpu.transform.MeshSpec`);
+    the rest is replicated. With ``param_specs`` (sharded-model track),
+    ``optimizer.init`` runs on each device's LOCAL param shard — the
+    grace residuals it allocates are therefore per-shard by construction,
+    which is the "error feedback lives on the shard owner" layout.
 
     Public because it is also the elastic re-shard's fresh-init hook
     (:func:`grace_tpu.resilience.elastic.reshard_grace_state`): a world
     resize re-initializes the per-rank GraceState payload by running
     exactly this init on the NEW mesh, then grafts the old replicated
     fields back via :func:`grace_tpu.transform.carry_replicated`."""
-    abstract = jax.eval_shape(optimizer.init, params)
-    specs = partition_specs(abstract, axis_name)
+    mesh_spec = MeshSpec.normalize(axis_name)
+    if param_specs is None:
+        in_spec: Any = P()
+        local_params = params
+    else:
+        if isinstance(param_specs, P):
+            param_specs = jax.tree_util.tree_map(lambda _: param_specs,
+                                                 params)
+        in_spec = param_specs
+
+        def shard_of(leaf, spec):
+            shape = list(jnp.shape(leaf))
+            for d, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                names = entry if isinstance(entry, tuple) else (entry,)
+                for n in names:
+                    shape[d] //= mesh.shape[n]
+            return jax.ShapeDtypeStruct(tuple(shape),
+                                        jnp.result_type(leaf))
+
+        local_params = jax.tree_util.tree_map(shard_of, params, param_specs)
+    abstract = jax.eval_shape(optimizer.init, local_params)
+    specs = partition_specs(abstract, mesh_spec)
     init_fn = shard_map(
         lambda p: add_world_axis(optimizer.init(p)),
-        mesh=mesh, in_specs=(P(),), out_specs=specs, check_vma=False)
+        mesh=mesh, in_specs=(in_spec,), out_specs=specs, check_vma=False)
     return jax.jit(init_fn)(params)
 
 
@@ -229,10 +294,23 @@ _init_opt_state = init_opt_state
 
 
 def init_train_state(params: Any, optimizer: optax.GradientTransformation,
-                     mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> TrainState:
+                     mesh: Mesh, axis_name=DEFAULT_AXIS,
+                     param_specs=None) -> TrainState:
+    if param_specs is None:
+        placed = jax.device_put(params, replicated(mesh))
+    else:
+        from jax.sharding import NamedSharding
+        if isinstance(param_specs, P):
+            param_specs = jax.tree_util.tree_map(lambda _: param_specs,
+                                                 params)
+        placed = jax.device_put(
+            params, jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), param_specs,
+                is_leaf=lambda x: isinstance(x, P)))
     return TrainState(
-        params=jax.device_put(params, replicated(mesh)),
-        opt_state=_init_opt_state(params, optimizer, mesh, axis_name))
+        params=placed,
+        opt_state=_init_opt_state(params, optimizer, mesh, axis_name,
+                                  param_specs=param_specs))
 
 
 def init_stateful_train_state(params: Any, model_state: Any,

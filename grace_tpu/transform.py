@@ -47,7 +47,9 @@ error feedback, not whichever replica the host happened to read.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Tuple
+import dataclasses
+import fnmatch
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,8 +57,9 @@ import numpy as np
 import optax
 from jax import lax
 
-from grace_tpu.core import (Communicator, Compressor, LinkBytes, Memory,
-                            State, Topology, axis_size)
+from grace_tpu.core import (Communicator, Compressor, DEFAULT_AXIS,
+                            LinkBytes, Memory, State, Topology, axis_size,
+                            negotiation_bytes_for)
 from grace_tpu.telemetry.aggregate import (normalize_watch,
                                            watch_gather_bytes, watch_init,
                                            watch_record)
@@ -64,6 +67,87 @@ from grace_tpu.telemetry.scopes import (STAGE_BUCKET, STAGE_TELEMETRY,
                                         STAGE_WATCH, trace_stage)
 from grace_tpu.telemetry.state import (TelemetryConfig, telemetry_init,
                                        telemetry_record)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """The transform's view of the device mesh: a data-parallel axis plus
+    an optional FSDP (sharded-model) axis.
+
+    Pure data parallelism — the only layout the repo spoke until the
+    sharded-model track — is the 1-axis degenerate case
+    (``fsdp_axis=None``), and every ``axis_name: str`` call site keeps
+    working via :meth:`normalize`. With ``fsdp_axis`` set, the training
+    step runs inside ``shard_map`` over a 2-D ``dp×fsdp`` mesh:
+
+    * **params and optimizer state are sharded over** ``fsdp_axis`` (the
+      caller's ``param_specs`` say how — typically embeddings/weights
+      split a dimension, LayerNorm/bias stay replicated), so each device
+      holds and updates only its *shard* of the model;
+    * **the gradient each device hands the grace transform is the
+      per-shard gradient**, and the compressed collective — the
+      communicator, whose ``axis_name`` must equal ``dp_axis`` — is the
+      per-shard reduce over the dp axis. ``lax`` collectives over
+      ``dp_axis`` inside a 2-D mesh operate within each fsdp shard's dp
+      group automatically, which is exactly the semantics FSDP needs;
+    * **GraceState mem/comp/telem/watch leaves shard over dp per fsdp
+      shard**: the global layout's leading world axis spans the dp×fsdp
+      *product* (``partition_specs`` emits ``P((dp, fsdp))``), so each
+      device's error-feedback residual covers exactly its own shard's
+      gradient — residuals live on the shard owner, never re-indexed
+      across shards (see IMPLEMENTING.md, "Why error feedback lives on
+      the shard owner");
+    * replicated GraceState fields (count/rng_key/fallback/audit) stay
+      ``P()`` — bit-identical across BOTH axes, which is what lets the
+      consensus audit fingerprint-match replicas *per fsdp shard* (its
+      collectives run over ``dp_axis`` only).
+    """
+
+    dp_axis: str = DEFAULT_AXIS
+    fsdp_axis: Optional[str] = None
+
+    def __post_init__(self):
+        if self.fsdp_axis is not None and self.fsdp_axis == self.dp_axis:
+            raise ValueError(
+                f"fsdp_axis must differ from dp_axis; both are "
+                f"{self.dp_axis!r}")
+
+    @property
+    def axes(self) -> Tuple[str, ...]:
+        """The mesh axis names, dp first."""
+        if self.fsdp_axis is None:
+            return (self.dp_axis,)
+        return (self.dp_axis, self.fsdp_axis)
+
+    @property
+    def is_2d(self) -> bool:
+        return self.fsdp_axis is not None
+
+    def varying_spec(self):
+        """PartitionSpec of a per-rank GraceState leaf's leading world
+        axis: ``P(dp)`` on a 1-D mesh (bit-compatible with every
+        pre-MeshSpec checkpoint/spec), ``P((dp, fsdp))`` on a 2-D mesh —
+        one leading axis over the device *product*, one row per
+        (dp, fsdp) rank."""
+        from jax.sharding import PartitionSpec as P
+
+        if self.fsdp_axis is None:
+            return P(self.dp_axis)
+        return P((self.dp_axis, self.fsdp_axis))
+
+    @classmethod
+    def normalize(cls, spec) -> "MeshSpec":
+        """Accept the ergonomic spellings: an axis-name string (pure dp —
+        every existing call site), a MeshSpec, or None (the default
+        axis)."""
+        if spec is None:
+            return cls()
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, str):
+            return cls(dp_axis=spec)
+        raise TypeError(f"mesh must be an axis-name str or MeshSpec; got "
+                        f"{type(spec).__name__}")
 
 
 class AuditState(NamedTuple):
@@ -172,27 +256,37 @@ def strip_world_axis(tree):
     return _map_grace_varying(strip, tree)
 
 
-def partition_specs(tree, axis_name: str):
-    """PartitionSpec pytree for a state pytree containing GraceState nodes:
-    mem/comp leaves shard their leading world axis over ``axis_name``;
-    everything else is replicated."""
+def partition_specs(tree, axis_name):
+    """PartitionSpec pytree for a state pytree containing GraceState nodes.
+
+    ``axis_name`` is an axis-name string (pure data parallelism — the
+    historical signature) or a :class:`MeshSpec`. Per-rank GraceState
+    leaves (mem/comp/telem/watch) shard their leading world axis over the
+    mesh: ``P(dp)`` on a 1-D mesh, ``P((dp, fsdp))`` on a 2-D dp×fsdp
+    mesh — per fsdp shard, the dp replicas' residuals/rings tile the same
+    leading axis, so the global array holds one row per device and the
+    shard owner keeps its own error feedback. Everything else (replicated
+    GraceState fields and non-grace leaves) is ``P()``; params and
+    param-shaped optimizer state on a sharded-model mesh carry their OWN
+    fsdp specs, supplied by the caller (``make_train_step(param_specs=)``)
+    — this function owns the GraceState contract, not the model's."""
     from jax.sharding import PartitionSpec as P
+
+    mesh = MeshSpec.normalize(axis_name)
+    vspec = mesh.varying_spec()
 
     def per_node(node):
         if _is_grace(node):
             return GraceState(
                 count=jax.tree_util.tree_map(lambda _: P(), node.count),
                 rng_key=jax.tree_util.tree_map(lambda _: P(), node.rng_key),
-                mem=jax.tree_util.tree_map(lambda _: P(axis_name), node.mem),
-                comp=jax.tree_util.tree_map(lambda _: P(axis_name),
-                                            node.comp),
+                mem=jax.tree_util.tree_map(lambda _: vspec, node.mem),
+                comp=jax.tree_util.tree_map(lambda _: vspec, node.comp),
                 fallback=jax.tree_util.tree_map(lambda _: P(),
                                                 node.fallback),
-                telem=jax.tree_util.tree_map(lambda _: P(axis_name),
-                                             node.telem),
+                telem=jax.tree_util.tree_map(lambda _: vspec, node.telem),
                 audit=jax.tree_util.tree_map(lambda _: P(), node.audit),
-                watch=jax.tree_util.tree_map(lambda _: P(axis_name),
-                                             node.watch))
+                watch=jax.tree_util.tree_map(lambda _: vspec, node.watch))
         return jax.tree_util.tree_map(lambda _: P(), node)
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -260,6 +354,69 @@ def carry_replicated(old_tree, fresh_tree, convert=None):
 
     return jax.tree_util.tree_map(graft, old_tree, fresh_tree,
                                   is_leaf=_is_grace)
+
+
+def leaf_path_str(path) -> str:
+    """The ``"/"``-joined spelling of a ``tree_flatten_with_path`` key path
+    — the string codec routes match against (and the same spelling the
+    static auditor's state paths use)."""
+    parts = []
+    for e in path:
+        for attr in ("name", "key", "idx"):
+            if hasattr(e, attr):
+                parts.append(str(getattr(e, attr)))
+                break
+        else:
+            parts.append(str(e))
+    return "/".join(parts)
+
+
+def normalize_routes(routes, base_communicator) -> Tuple:
+    """Normalize a per-leaf codec routing table to
+    ``((pattern, compressor, memory, communicator), ...)``.
+
+    Each entry is ``(pattern, triad)`` where ``pattern`` is an
+    ``fnmatch`` glob matched against the leaf's ``"/"``-joined tree path
+    (``"*emb*"``, ``"layers/*/ln*/*"``) and ``triad`` is either a
+    3-tuple ``(compressor, memory, communicator)`` or any object with
+    those attributes (a :class:`grace_tpu.helper.Grace` bundle). First
+    match wins; unmatched leaves ride the transform's base triad. Every
+    route's communicator must exchange over the SAME mesh axis as the
+    base one — per-leaf pipelines issue separate collectives, but they
+    all rendezvous on one dp axis."""
+    out = []
+    for entry in routes:
+        if len(entry) == 4:          # already-normalized 4-tuple
+            pat, comp, mem, cm = entry
+        else:
+            pat, triad = entry
+            if isinstance(triad, (tuple, list)):
+                if len(triad) != 3:
+                    raise ValueError(
+                        f"route {pat!r}: triad must be (compressor, "
+                        f"memory, communicator); got {len(triad)} "
+                        "elements")
+                comp, mem, cm = triad
+            else:
+                comp, mem, cm = (triad.compressor, triad.memory,
+                                 triad.communicator)
+        if cm.axis_name != base_communicator.axis_name:
+            raise ValueError(
+                f"route {pat!r}: communicator axis {cm.axis_name!r} "
+                f"differs from the base communicator's "
+                f"{base_communicator.axis_name!r} — all routed exchanges "
+                "must rendezvous on one dp axis")
+        out.append((str(pat), comp, mem, cm))
+    return tuple(out)
+
+
+def route_for(routes, path_str: str, default):
+    """The ``(compressor, memory, communicator)`` triad for one leaf path:
+    the first route whose pattern matches, else ``default``."""
+    for pat, comp, mem, cm in routes:
+        if fnmatch.fnmatchcase(path_str, pat):
+            return comp, mem, cm
+    return default
 
 
 def _bucketize(shapes_dtypes, bucket_bytes: Optional[int]):
@@ -382,7 +539,9 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     telemetry=None,
                     consensus=None,
                     topology: Optional[Topology] = None,
-                    watch=None
+                    watch=None,
+                    mesh=None,
+                    routes: Optional[Sequence] = None
                     ) -> optax.GradientTransformation:
     """Build the compressed-exchange transformation.
 
@@ -493,6 +652,30 @@ def grace_transform(compressor: Compressor, memory: Memory,
     value arms the state; the schedule/repair knobs are read from the
     config handed to the train step.
 
+    ``mesh`` (None | axis-name str | :class:`MeshSpec`): the mesh layout
+    the transform runs under. ``None``/str is pure data parallelism over
+    the communicator's axis (today's behavior, unchanged byte-for-byte).
+    A 2-D :class:`MeshSpec` declares the sharded-model track: the
+    communicator's ``axis_name`` must equal ``mesh.dp_axis`` (the
+    exchange is the per-shard reduce over dp; a collective over the dp
+    axis inside a 2-D shard_map operates within each fsdp shard's dp
+    group automatically), and ``partition_specs`` built from the same
+    MeshSpec shards the per-rank GraceState leaves over the dp×fsdp
+    product — residuals live on the shard owner.
+
+    ``routes`` (None | ``[(pattern, triad), ...]``): first-class per-leaf
+    codec routing (see :func:`normalize_routes`). Wire bytes in a
+    transformer concentrate in embeddings/tied layers while
+    LayerNorm/bias leaves hate sparsification — routing gives each leaf
+    family its own (compressor, memory, communicator) triad, matched by
+    fnmatch glob against the leaf's tree path, with unmatched leaves on
+    the base triad. Requires ``fusion=None``: routing IS per-leaf
+    semantics (a flat/bucketed concat would fuse leaves with different
+    codecs into one payload). The telemetry wire plan, the per-link
+    split, and the static auditor's wire reconciliation all price routed
+    configs as the SUM of per-leaf prices through each leaf's own codec
+    and communicator.
+
     ``watch`` (None | True | int ``window`` | dict | ``WatchConfig``): arm
     graft-watch (:mod:`grace_tpu.telemetry.aggregate`) — every
     ``window``-th step all_gathers each rank's local health vector
@@ -509,6 +692,22 @@ def grace_transform(compressor: Compressor, memory: Memory,
     """
     telemetry = _normalize_telemetry(telemetry)
     watch = normalize_watch(watch)
+    mesh = MeshSpec.normalize(mesh if mesh is not None
+                              else communicator.axis_name)
+    if mesh.dp_axis != communicator.axis_name:
+        raise ValueError(
+            f"mesh.dp_axis {mesh.dp_axis!r} differs from the "
+            f"communicator's axis_name {communicator.axis_name!r} — the "
+            "compressed exchange IS the per-shard reduce over the dp "
+            "axis, so the two must name the same mesh axis.")
+    routes = (normalize_routes(routes, communicator) if routes else ())
+    if routes and fusion is not None:
+        raise ValueError(
+            "routes=... requires fusion=None: per-leaf codec routing is "
+            "per-leaf semantics — 'flat'/'grouped'/bucketed fusion "
+            "concatenates or stacks leaves, which would fuse leaves "
+            "with different codecs into one payload. Route instead of "
+            "fusing (each leaf family already gets its own collective).")
     if watch is not None and telemetry is None:
         raise ValueError(
             "watch=... requires telemetry=...: graft-watch summarizes the "
@@ -556,8 +755,33 @@ def grace_transform(compressor: Compressor, memory: Memory,
         return _bucketize([(jnp.shape(l), jnp.result_type(l))
                            for l in leaves], bucket_bytes)
 
+    _base_triad = (compressor, memory, communicator)
+
+    def _leaf_triads(tree):
+        """Per-leaf (compressor, memory, communicator) plan for a pytree:
+        (paths, triads), first matching route wins, base triad otherwise.
+        Deterministic in leaf order so init and update always agree."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        paths = [leaf_path_str(p) for p, _leaf in flat]
+        return paths, [route_for(routes, p, _base_triad) for p in paths]
+
     def init(params) -> GraceState:
         leaves = jax.tree_util.tree_leaves(params)
+        if routes:
+            _, triads = _leaf_triads(params)
+            mem = tuple(m.init_state(p)
+                        for p, (_c, m, _cm) in zip(leaves, triads))
+            comp = tuple(c.init_state(p)
+                         for p, (c, _m, _cm) in zip(leaves, triads))
+            return GraceState(
+                count=jnp.zeros((), jnp.int32),
+                rng_key=jax.random.key_data(jax.random.key(seed)),
+                mem=mem, comp=comp,
+                fallback=jnp.zeros((), jnp.bool_),
+                telem=(telemetry_init(telemetry)
+                       if telemetry is not None else None),
+                audit=audit_init() if consensus_armed else None,
+                watch=(watch_init(watch) if watch is not None else None))
         if grouped:
             stacks = [jnp.stack([leaves[i] for i in idxs])
                       for idxs in _group_views(leaves)]
@@ -679,11 +903,13 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 new_comp.append(cs)
         else:
             outs = []
+            triads = _route_plan[0] if routes else None
             for i, (g, ms, cs) in enumerate(zip(leaves, mem, comp,
                                                 strict=True)):
+                comp_i, mem_i, cm_i = (triads[i] if triads is not None
+                                       else _base_triad)
                 rng = jax.random.fold_in(step_key, i)
-                out, ms, cs = communicator.step(g, ms, cs, memory, compressor,
-                                                rng)
+                out, ms, cs = cm_i.step(g, ms, cs, mem_i, comp_i, rng)
                 outs.append(out)
                 new_mem.append(ms)
                 new_comp.append(cs)
@@ -711,6 +937,44 @@ def grace_transform(compressor: Compressor, memory: Memory,
     # -- telemetry ----------------------------------------------------------
 
     _wire_plan_cache: dict = {}
+    # Trace-time cell: the per-leaf route plan of the update being traced
+    # (triads aligned with the flattened leaves). Set by update() before
+    # the escape cond so both branches (and the telemetry pricing) read
+    # one consistent plan; pure Python state, never traced.
+    _route_plan: list = [None]
+
+    def _routed_wire_plan(leaves, world):
+        """Routed twin of ``_wire_plan``: dense/link/escape/negotiation
+        prices summed per leaf through each leaf's OWN codec and
+        communicator — the sum-of-per-leaf-prices contract the static
+        auditor's wire reconciliation holds routed configs to."""
+        from grace_tpu.comm import Allreduce
+        from grace_tpu.utils.metrics import payload_nbytes
+
+        triads = _route_plan[0]
+        topo = resolved_topology
+        structs = [jax.ShapeDtypeStruct(tuple(jnp.shape(l)),
+                                        jnp.result_type(l)) for l in leaves]
+        dense = n_elems = ici = dcn = neg_b = 0
+        for s, (comp_i, _mem_i, cm_i) in zip(structs, triads):
+            ne = int(np.prod(s.shape, dtype=np.int64))
+            dense += ne * s.dtype.itemsize
+            n_elems += ne
+            vote_i = bool(getattr(comp_i, "vote_aggregate", False))
+            lb = cm_i.recv_link_bytes(payload_nbytes(comp_i, s), ne, world,
+                                      topology=topo, vote=vote_i)
+            ici += lb.ici
+            dcn += lb.dcn
+            neg_b += negotiation_bytes_for(comp_i, ne, world)
+        link = LinkBytes(ici=ici, dcn=dcn)
+        if escape is not None:
+            esc_b = sum(payload_nbytes(escape, s) for s in structs)
+            esc_link = Allreduce(
+                axis_name=communicator.axis_name).recv_link_bytes(
+                    esc_b, n_elems, world, topology=topo)
+        else:
+            esc_link = None
+        return dense, link, esc_link, neg_b
 
     def _bound_axis_size(axis_name) -> int:
         """Static world size when the mesh axis is bound (inside
@@ -746,6 +1010,10 @@ def grace_transform(compressor: Compressor, memory: Memory,
         :func:`grace_tpu.utils.metrics.wire_report`."""
         from grace_tpu.utils.metrics import payload_nbytes
 
+        if routes:
+            # Per-leaf routed pricing; uncached (the plan depends on leaf
+            # paths, not just shapes — and this is trace-time-only cost).
+            return _routed_wire_plan(leaves, world)
         sig = tuple((tuple(jnp.shape(l)), str(jnp.result_type(l)))
                     for l in leaves)
         plan = _wire_plan_cache.get((sig, world))
@@ -791,10 +1059,11 @@ def grace_transform(compressor: Compressor, memory: Memory,
         else:
             esc_link = None
         # One negotiation collective per compress call the fusion plan
-        # issues (per bucket/leaf/group) — zero for codecs without one.
-        n_calls = sum(count for _, count
-                      in fusion_payload_structs(structs, fusion))
-        neg_b = n_calls * int(compressor.negotiation_nbytes(world))
+        # issues (per bucket/leaf/group) — zero for codecs without one,
+        # leaf-size-aware for index negotiations (cyclic Top-K).
+        neg_b = sum(count * negotiation_bytes_for(
+            compressor, int(np.prod(s.shape, dtype=np.int64)), world)
+            for s, count in fusion_payload_structs(structs, fusion))
         plan = _wire_plan_cache[(sig, world)] = (dense, link, esc_link,
                                                  neg_b)
         return plan
@@ -834,11 +1103,13 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 diff = diff + _sqsum([flat
                                       - compressor.decompress(payload, ctx)])
         else:
+            triads = _route_plan[0] if routes else None
             for i, g in enumerate(leaves):
-                payload, ctx, _ = compressor.compress(
+                comp_i = (triads[i][0] if triads is not None
+                          else compressor)
+                payload, ctx, _ = comp_i.compress(
                     g, comp[i], jax.random.fold_in(step_key, i))
-                diff = diff + _sqsum([g - compressor.decompress(payload,
-                                                                ctx)])
+                diff = diff + _sqsum([g - comp_i.decompress(payload, ctx)])
         return diff
 
     def _telemetry_next(state: GraceState, leaves, outs, new_mem, step_key):
@@ -967,6 +1238,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
     def update(updates, state: GraceState, params=None):
         del params
         leaves, treedef = jax.tree_util.tree_flatten(updates)
+        if routes:
+            _route_plan[0] = _leaf_triads(updates)[1]
         base_key = jax.random.wrap_key_data(state.rng_key)
         step_key = jax.random.fold_in(base_key, state.count)
         operand = (tuple(leaves), state.mem, state.comp, step_key)
@@ -995,4 +1268,9 @@ def grace_transform(compressor: Compressor, memory: Memory,
     # exposed so tests can pin the single-invalidation-point contract
     # (None when telemetry is off: nothing prices a per-link split).
     update.grace_topology = resolved_topology
+    # The mesh layout and route table the transform was built under —
+    # read by the static auditor's tracer (2-D replication seeding) and
+    # the routed wire reconciliation.
+    update.grace_mesh = mesh
+    update.grace_routes = routes
     return optax.GradientTransformation(init, update)

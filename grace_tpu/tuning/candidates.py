@@ -107,7 +107,29 @@ def generated_variants(spec: TuneTopology) -> List[Candidate]:
         Candidate("tune-homoqsgd4-ring",
                   {**homoq, "communicator": "ring", "fusion": "flat"},
                   source="generated"),
+        # The FSDP exchange (ISSUE 14): one all_to_all + one all_gather,
+        # requant chain ≤ 1 at ANY world — the flat-topology schedule
+        # that survives the degradation gate where the hop-requant ring
+        # dies at pod scale.
+        Candidate("tune-topk1pct-rscatter",
+                  {**topk, "communicator": "rscatter", "fusion": "flat"},
+                  source="generated"),
+        Candidate("tune-homoqsgd4-rscatter",
+                  {**homoq, "communicator": "rscatter", "fusion": "flat"},
+                  source="generated"),
     ]
+    if spec.fsdp is not None and spec.fsdp > 1:
+        # Sharded-model target: the routed transformer-track shape — the
+        # bulk of the gradient rides sparsification through the per-shard
+        # reduce-scatter, LayerNorm/bias leaves ride dense fp16 psum.
+        out.append(Candidate(
+            "tune-routed-rscatter-fsdp",
+            {**topk, "communicator": "rscatter", "fsdp_axis": "fsdp",
+             "route": [("*ln*", {"compressor": "fp16", "memory": "none",
+                                 "communicator": "allreduce"}),
+                       ("*bias*", {"compressor": "fp16", "memory": "none",
+                                   "communicator": "allreduce"})]},
+            source="generated"))
     s = spec.slice_size
     if s is not None and spec.world > s:
         out += [
@@ -154,51 +176,93 @@ def _compressor_stateful(compressor) -> bool:
     return s is not None
 
 
-def candidate_legal(candidate: Candidate, spec: TuneTopology
-                    ) -> Tuple[bool, Optional[str], Any]:
-    """(legal, reason, grace) — the static mirror of the communicators'
-    build/step-time gates, evaluated at the TARGET world. ``grace`` is the
-    built bundle when construction succeeded (legal or not), else None."""
+def _triad_legal(comp, cm, spec: TuneTopology) -> Optional[str]:
+    """The static mirror of the communicators' build/step-time gates for
+    one (compressor, communicator) pair at the TARGET world — the reason
+    the runtime would raise, or None."""
     from grace_tpu import comm
 
-    try:
-        grace = candidate.build()
-    except (TypeError, ValueError) as e:
-        return False, f"does not build: {type(e).__name__}: {e}", None
-    comp, cm = grace.compressor, grace.communicator
     w = spec.world
     vote = bool(getattr(comp, "vote_aggregate", False))
     summable = bool(getattr(comp, "summable_payload", False))
     requant = bool(getattr(comp, "supports_hop_requant", False))
+    shard_parallel = (comm.TwoShotAllreduce, comm.RingAllreduce,
+                      comm.ReduceScatterAllreduce,
+                      comm.HierarchicalAllreduce)
 
     if isinstance(cm, comm.SignAllreduce) and not vote:
-        return False, ("SignAllreduce requires vote_aggregate=True "
-                       f"({type(comp).__name__} declares False) — the "
-                       "re-sign would drop its aggregate's scaling"), grace
+        return ("SignAllreduce requires vote_aggregate=True "
+                f"({type(comp).__name__} declares False) — the "
+                "re-sign would drop its aggregate's scaling")
     if type(cm) is comm.Allreduce and not (vote or summable):
-        return False, ("Allreduce requires summable_payload=True "
-                       f"({type(comp).__name__} declares False) — per-rank "
-                       "payloads decode differently"), grace
-    if isinstance(cm, (comm.TwoShotAllreduce, comm.RingAllreduce,
-                       comm.HierarchicalAllreduce)):
+        return ("Allreduce requires summable_payload=True "
+                f"({type(comp).__name__} declares False) — per-rank "
+                "payloads decode differently")
+    if isinstance(cm, shard_parallel):
         if _compressor_stateful(comp):
-            return False, (f"{type(cm).__name__} requires a stateless "
-                           f"compressor; {type(comp).__name__} carries "
-                           "cross-step state with no per-chunk meaning"), \
-                grace
-    if isinstance(cm, (comm.RingAllreduce, comm.HierarchicalAllreduce)) \
+            return (f"{type(cm).__name__} requires a stateless "
+                    f"compressor; {type(comp).__name__} carries "
+                    "cross-step state with no per-chunk meaning")
+        # The data-free-ctx soundness condition _shard_compress raises at
+        # step time (ranks decode each other's shard payloads with
+        # locally derived ctx) — mirrored here so a codec whose whole-
+        # buffer negotiation cannot be sharded (cyclic Top-K's index set)
+        # dies in the funnel with the runtime's own rationale instead of
+        # a mid-measurement TypeError. shared_scale codecs are exempt:
+        # their hoisted negotiation replaces the gate.
+        if getattr(comp, "payload_algebra", None) != "shared_scale":
+            import jax.numpy as jnp
+
+            from grace_tpu.comm import ctx_is_data_free
+            try:
+                data_free = ctx_is_data_free(comp, 64, jnp.float32)
+            except Exception:
+                data_free = False
+            if not data_free:
+                return (f"{type(cm).__name__} requires a data-free ctx; "
+                        f"{type(comp).__name__}.compress puts "
+                        "data-derived arrays in ctx — other ranks' shards "
+                        "would decode against the wrong values")
+    if isinstance(cm, (comm.RingAllreduce, comm.ReduceScatterAllreduce,
+                       comm.HierarchicalAllreduce)) \
             and not (summable or requant):
-        return False, (f"{type(cm).__name__} keeps the payload compressed "
-                       "on every hop, which needs a payload algebra "
-                       "(exact/shared_scale/sketch — summable_payload) or "
-                       f"supports_hop_requant; {type(comp).__name__} "
-                       "declares neither"), grace
+        return (f"{type(cm).__name__} keeps the payload compressed "
+                "on every hop, which needs a payload algebra "
+                "(exact/shared_scale/sketch — summable_payload) or "
+                f"supports_hop_requant; {type(comp).__name__} "
+                "declares neither")
     if isinstance(cm, comm.HierarchicalAllreduce):
         s = cm.slice_size
         if s is not None and w > s and w % s:
-            return False, (f"HierarchicalAllreduce(slice_size={s}) does "
-                           f"not divide world {w} — the two-level schedule "
-                           "needs whole slices"), grace
+            return (f"HierarchicalAllreduce(slice_size={s}) does "
+                    f"not divide world {w} — the two-level schedule "
+                    "needs whole slices")
+    return None
+
+
+def candidate_legal(candidate: Candidate, spec: TuneTopology
+                    ) -> Tuple[bool, Optional[str], Any]:
+    """(legal, reason, grace) — the static mirror of the communicators'
+    build/step-time gates, evaluated at the TARGET world. ``grace`` is the
+    built bundle when construction succeeded (legal or not), else None.
+    Routed candidates check every route's sub-triad too (plus the
+    routes×fusion build gate grace_transform enforces), so an illegal
+    routed combo dies in the funnel with the runtime's own rationale."""
+    try:
+        grace = candidate.build()
+    except (TypeError, ValueError) as e:
+        return False, f"does not build: {type(e).__name__}: {e}", None
+    if getattr(grace, "routes", None) and grace.fusion is not None:
+        return False, ("routes=... requires fusion=None: per-leaf codec "
+                       "routing is per-leaf semantics (grace_transform "
+                       "raises the same gate at build time)"), grace
+    reason = _triad_legal(grace.compressor, grace.communicator, spec)
+    if reason:
+        return False, reason, grace
+    for pat, comp, _mem, cm in (getattr(grace, "routes", None) or ()):
+        reason = _triad_legal(comp, cm, spec)
+        if reason:
+            return False, f"route {pat!r}: {reason}", grace
     return True, None, grace
 
 
@@ -225,4 +289,11 @@ def variant_audit_entries() -> List[Tuple[str, Dict[str, Any], str]]:
           "memory": "none", "communicator": "hier", "slice_size": 4,
           "fusion": "flat"},
          "packed 4-bit wire over hier hop+boundary requant"),
+        # The tuner's FSDP variants (ISSUE 14): the homomorphic rscatter
+        # (zero requant through all_to_all + payload-space sum) must be a
+        # lint-audited schedule, not just a funnel line.
+        ("tune-homoqsgd4-rscatter",
+         {"compressor": "homoqsgd", "quantum_num": 7, "memory": "residual",
+          "communicator": "rscatter", "fusion": "flat"},
+         "homomorphic payload-space sum over the rscatter schedule"),
     ]

@@ -72,16 +72,22 @@ def projection_constants():
 
 @dataclasses.dataclass(frozen=True)
 class TuneTopology:
-    """The tuner's target mesh: world size + ICI slice width.
+    """The tuner's target mesh: dp world size + ICI slice width + optional
+    fsdp width (the 2-D sharded-model mesh).
 
     ``slice_size=None`` is a single ICI slice of any width (the regime
     every committed single-chip measurement ran in); ``W=256, slice8`` is
     the xslice projection topology. Parsed from the CLI's ``W`` /
-    ``W,slice_size`` spelling.
+    ``W,slice_size`` / ``dp×fsdp[,slice_size]`` spelling (``64x4,8`` =
+    dp=64 × fsdp=4, slices of 8). ``world`` is the EXCHANGE (dp) axis
+    size — the span every wire/numeric model prices, because the
+    compressed collective is the per-shard reduce over dp; ``fsdp``
+    multiplies the device count without widening any priced collective.
     """
 
     world: int
     slice_size: Optional[int] = None
+    fsdp: Optional[int] = None
 
     def __post_init__(self):
         if self.world < 1:
@@ -89,26 +95,41 @@ class TuneTopology:
         if self.slice_size is not None and self.slice_size < 1:
             raise ValueError(
                 f"slice_size must be >= 1 or None; got {self.slice_size}")
+        if self.fsdp is not None and self.fsdp < 1:
+            raise ValueError(f"fsdp must be >= 1 or None; got {self.fsdp}")
 
     @classmethod
     def parse(cls, text: str) -> "TuneTopology":
         parts = [p.strip() for p in str(text).split(",") if p.strip()]
         if not parts or len(parts) > 2:
             raise ValueError(
-                f"topology spec {text!r} is not 'W' or 'W,slice_size'")
-        world = int(parts[0])
+                f"topology spec {text!r} is not 'W', 'W,slice_size', or "
+                "'DPxFSDP[,slice_size]'")
+        head = parts[0].lower().replace("×", "x")
+        if "x" in head:
+            dp_s, fsdp_s = head.split("x", 1)
+            world, fsdp = int(dp_s), int(fsdp_s)
+        else:
+            world, fsdp = int(head), None
         slice_size = int(parts[1]) if len(parts) == 2 else None
-        return cls(world=world, slice_size=slice_size)
+        return cls(world=world, slice_size=slice_size, fsdp=fsdp)
 
     def core_topology(self):
         from grace_tpu.core import Topology
         return Topology(slice_size=self.slice_size)
 
     @property
+    def devices(self) -> int:
+        """Total device count: dp × fsdp."""
+        return self.world * (self.fsdp or 1)
+
+    @property
     def label(self) -> str:
+        w = (f"W{self.world}" if self.fsdp is None
+             else f"W{self.world}x{self.fsdp}")
         if self.slice_size is None:
-            return f"W{self.world}"
-        return f"W{self.world}/slice{self.slice_size}"
+            return w
+        return f"{w}/slice{self.slice_size}"
 
 
 def dense_bytes(model_structs) -> int:

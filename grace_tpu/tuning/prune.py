@@ -110,6 +110,7 @@ def numeric_verdict(grace, spec: TuneTopology) -> Optional[str]:
                     "silently round — the runtime vote guard raises here")
     summable = bool(getattr(comp, "summable_payload", False))
     sums_payload = (isinstance(cm, (comm.Allreduce, comm.RingAllreduce,
+                                    comm.ReduceScatterAllreduce,
                                     comm.HierarchicalAllreduce))
                     and summable and not vote)
     if sums_payload:
@@ -144,7 +145,9 @@ def requant_chain_length(grace, spec: TuneTopology) -> int:
     gather/vote schedules; W−1 for a flat hop-requant ring; S−1 intra-slice
     hops + 1 slice-boundary re-encode for hier's requant path (the design
     point: one boundary requant regardless of K); 1 for two-shot's stage-2
-    re-compression."""
+    re-compression and for rscatter's single post-reduce re-encode (the
+    FSDP schedule: one requant boundary at ANY world — never
+    degradation-gated)."""
     from grace_tpu import comm
 
     comp, cm = grace.compressor, grace.communicator
@@ -155,6 +158,8 @@ def requant_chain_length(grace, spec: TuneTopology) -> int:
         if isinstance(cm, comm.TwoShotAllreduce) and not summable:
             return 1
         return 0
+    if isinstance(cm, comm.ReduceScatterAllreduce):
+        return 1
     if isinstance(cm, comm.HierarchicalAllreduce):
         s = cm.slice_size
         if s is None or w <= s:

@@ -57,10 +57,13 @@ def test_registry_covers_compressor_catalog():
                "natural", "dgc", "powersgd", "sketch", "u8bit", "adaq",
                "inceptionn",
                # the aggregation-homomorphic family (ISSUE 13)
-               "homoqsgd", "countsketch"}
+               "homoqsgd", "countsketch",
+               # the sharded-model track (ISSUE 14): ScaleCom-style
+               # cyclic local-selection topk
+               "cyclictopk"}
     assert catalog <= audited
     # and the catalog names really are the exported classes
-    assert len(C.__all__) == 20
+    assert len(C.__all__) == 21
 
 
 def test_incompatible_config_traces_to_a_finding():

@@ -7,13 +7,14 @@ from grace_tpu import compressors as C
 from grace_tpu import memories as M
 from grace_tpu.helper import grace_from_params
 
-ALL_COMPRESSORS = ["none", "fp16", "bf16", "topk", "randomk", "threshold",
-                   "qsgd", "terngrad", "signsgd", "signum", "efsignsgd",
-                   "onebit", "natural", "dgc", "powersgd", "u8bit", "sketch",
-                   "adaq", "inceptionn"]
+ALL_COMPRESSORS = ["none", "fp16", "bf16", "topk", "cyclictopk", "randomk",
+                   "threshold", "qsgd", "terngrad", "signsgd", "signum",
+                   "efsignsgd", "onebit", "natural", "dgc", "powersgd",
+                   "u8bit", "sketch", "adaq", "inceptionn"]
 ALL_MEMORIES = ["none", "residual", "efsignsgd", "dgc", "powersgd"]
 ALL_COMMUNICATORS = ["allreduce", "allgather", "broadcast", "identity",
-                     "twoshot", "ring", "hier", "sign_allreduce"]
+                     "twoshot", "ring", "rscatter", "hier",
+                     "sign_allreduce"]
 
 
 @pytest.mark.parametrize("name", ALL_COMPRESSORS)

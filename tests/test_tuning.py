@@ -205,18 +205,24 @@ def test_static_ranks_full_registry_under_both_topologies(static_doc):
     assert static_doc["ok"] is True
 
 
-def test_static_top_pick_at_xslice_is_hier_family(static_doc):
-    """ISSUE 12 acceptance: the top static pick at W=256/slice8 is the
-    hier config family — consistent with the pinned 1.06× xslice
-    projection (topk1pct_hier beats dense where flat allgather loses)."""
+def test_static_top_pick_at_xslice_is_sharded_or_hier_family(static_doc):
+    """ISSUE 12/14 acceptance: the top static pick at W=256/slice8 is the
+    rscatter family — the ISSUE-14 one-shot reduce-scatter moves ~2·k
+    over DCN where hier still ships (K−1)·k/S partials, and its requant
+    chain is ≤1 at any W so the degradation gate never rejects it — with
+    the hier family (the pinned 1.06× xslice projection) right behind,
+    still carrying the genuinely mixed split."""
     st = static_doc["static"]["W256/slice8"]
     top = st["ranking"][0]
     rec = next(r for r in st["funnel"] if r["candidate"] == top["candidate"])
-    assert rec["params"]["communicator"] == "hier"
-    assert rec["params"]["slice_size"] == 8
+    assert rec["params"]["communicator"] == "rscatter"
+    assert rec["requant_chain"] <= 1
     assert top["predicted_speedup_vs_dense"] > 1.0
-    # and the mixed split is real: both links carry bytes
-    assert top["ici_bytes"] > 0 and top["dcn_bytes"] > 0
+    # hier is the runner-up family, and its mixed split is real: both
+    # links carry bytes
+    hier = next(r for r in st["ranking"]
+                if "hier" in r["candidate"])
+    assert hier["ici_bytes"] > 0 and hier["dcn_bytes"] > 0
     # while the flat-communicator candidates degenerate to all-DCN there
     flat = next(r for r in st["funnel"]
                 if r["candidate"] == "topk-allgather"

@@ -187,6 +187,23 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt-dir", default="",
                     help="checkpoint directory for the elastic drain "
                          "(default: a fresh temp dir)")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="sharded-model scenario (ISSUE 14): the chaos "
+                         "matrix over a 2-D dp×fsdp mesh (4×2 of the 8 "
+                         "devices) — a tensor-parallel MLP with w1 "
+                         "sharded over fsdp, the ROUTED rscatter exchange "
+                         "(big leaves topk per-shard reduce-scatter, "
+                         "bias leaves dense fp16 psum), NaN injection "
+                         "(guard rollback must stay atomic across the "
+                         "per-shard exchanges) plus single-rank param "
+                         "SDC (the consensus audit fingerprints "
+                         "replicated fields PER FSDP SHARD over the dp "
+                         "axis and must repair within one window, "
+                         "residual zeroing scoped to the divergent "
+                         "rank). Telemetry rows must carry the two-axis "
+                         "wire split (wire_bytes_ici/wire_bytes_dcn)")
+    ap.add_argument("--fsdp-size", type=int, default=2,
+                    help="fsdp axis width (dp = 8 // fsdp_size)")
     ap.add_argument("--lint", action="store_true",
                     help="first run graft-lint (repo rules + a static "
                          "audit of this smoke's own grace config); "
@@ -212,6 +229,8 @@ def main(argv=None) -> int:
 
     if args.elastic:
         return _elastic_main(args)
+    if args.fsdp:
+        return _fsdp_main(args)
 
     import jax.numpy as jnp
     import numpy as np
@@ -501,6 +520,239 @@ def main(argv=None) -> int:
             return 1
     print("[chaos_smoke] OK")
     return 0
+
+
+def _fsdp_main(args) -> int:
+    """The sharded-model chaos scenario: guard rollback + consensus
+    repair over a 2-D dp×fsdp mesh with the routed rscatter exchange.
+
+    Exit 0 requires: final loss finite; the guard tripped (NaN injection
+    reaches every per-shard exchange); every injected SDC repaired with
+    residual zeroing scoped to the divergent rank (consensus fingerprints
+    match replicas PER FSDP SHARD — param shards legitimately differ
+    across fsdp); and the telemetry artifact's rows carry the two-axis
+    wire split (``wire_bytes_ici``/``wire_bytes_dcn``).
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from grace_tpu import grace_from_params
+    from grace_tpu.parallel import make_mesh
+    from grace_tpu.resilience import (ChaosCommunicator, ChaosParams,
+                                      ConsensusConfig, audit_report,
+                                      guarded_chain)
+    from grace_tpu.telemetry import JSONLSink, TelemetryReader
+    from grace_tpu.train import init_train_state, make_train_step
+    from grace_tpu.transform import MeshSpec
+    from grace_tpu.utils.logging import (ConsensusMonitor, GuardMonitor,
+                                         run_provenance)
+    from grace_tpu.utils.metrics import guard_report
+
+    fsdp = max(1, args.fsdp_size)
+    if 8 % fsdp:
+        print(f"[chaos_smoke] --fsdp-size {fsdp} does not divide the "
+              "8-device mesh", file=sys.stderr)
+        return 1
+    dp = 8 // fsdp
+    mesh = make_mesh((dp, fsdp), ("data", "fsdp"))
+    mesh_spec = MeshSpec("data", "fsdp")
+
+    feat, hid, classes = 32, 16, 8
+    rng = np.random.default_rng(args.seed)
+    params = {
+        "w1": jnp.asarray(rng.normal(scale=0.3, size=(feat, hid)),
+                          jnp.float32),
+        "b1": jnp.zeros((hid,), jnp.float32),
+        "w2": jnp.asarray(rng.normal(scale=0.3, size=(hid, classes)),
+                          jnp.float32),
+        "b2": jnp.zeros((classes,), jnp.float32),
+    }
+    # EVERY param is fsdp-sharded — the honest FSDP layout. This is not
+    # cosmetic: a param replicated across fsdp would have its gradient
+    # aggregated independently per dp group (collectives span dp only),
+    # so a single corrupt rank could contaminate ITS group's aggregate
+    # and silently diverge the "replicated" copies ACROSS groups where
+    # the per-fsdp-shard consensus audit structurally cannot see it.
+    # Sharding everything over fsdp keeps each shard's trajectory inside
+    # exactly one dp group — the audit's jurisdiction.
+    param_specs = {"w1": P("fsdp", None), "b1": P("fsdp"),
+                   "w2": P("fsdp", None), "b2": P("fsdp")}
+    feat_sh, hid_sh = feat // fsdp, hid // fsdp
+
+    def loss_fn(p, b):
+        x, y = b
+        f = lax.axis_index("fsdp")
+        # FSDP forward: gather the sharded biases, contract each weight
+        # shard against this shard's input slice, psum the partials over
+        # fsdp. The all_gather's transpose hands each owner exactly its
+        # shard's bias gradient — per-shard gradients by construction.
+        b1 = lax.all_gather(p["b1"], "fsdp", axis=0, tiled=True)
+        b2 = lax.all_gather(p["b2"], "fsdp", axis=0, tiled=True)
+        xs = lax.dynamic_slice_in_dim(x, f * feat_sh, feat_sh, 1)
+        h = jnp.tanh(lax.psum(xs @ p["w1"], "fsdp") + b1)
+        hs = lax.dynamic_slice_in_dim(h, f * hid_sh, hid_sh, 1)
+        logits = lax.psum(hs @ p["w2"], "fsdp") + b2
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    consensus = ConsensusConfig(
+        audit_every=args.audit_every,
+        escalate_window=4 * args.audit_every,
+        escalate_steps=args.fallback_steps)
+    sdc_steps = (tuple(int(s) for s in args.sdc_steps.split(","))
+                 if args.sdc_steps
+                 else (args.steps // 3, 2 * args.steps // 3))
+    sdc = ChaosParams(rank=args.sdc_rank, at_steps=sdc_steps,
+                      seed=args.seed + 2)
+
+    grace_params = {
+        "compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+        "communicator": "rscatter", "fsdp_axis": "fsdp",
+        # slice boundary inside the dp axis: the flat rscatter's rows
+        # honestly price a DCN leg — the artifact's two-axis wire split.
+        "slice_size": max(1, dp // 2),
+        "route": [("b*", {"compressor": "fp16", "memory": "none",
+                          "communicator": "allreduce"})],
+        "escape": "fp16", "consensus": consensus,
+        "telemetry": max(2 * args.telemetry_every, 16),
+    }
+    grc = grace_from_params(grace_params)
+    grc = _dc.replace(grc, communicator=ChaosCommunicator(
+        inner=grc.communicator, nan_prob=args.nan_prob, rank=args.rank,
+        seed=args.seed + 1))
+    tx = guarded_chain(grc, optax.sgd(args.lr),
+                       fallback_after=args.fallback_after,
+                       fallback_steps=args.fallback_steps)
+
+    state = init_train_state(params, tx, mesh, axis_name=mesh_spec,
+                             param_specs=param_specs)
+    step = make_train_step(loss_fn, tx, mesh, axis_name=mesh_spec,
+                           param_specs=param_specs, donate=False,
+                           consensus=consensus)
+
+    sink = reader = None
+    if args.telemetry_out:
+        sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+            data="synthetic", tool="chaos_smoke",
+            argv=" ".join(sys.argv[1:]),
+            nan_prob=args.nan_prob, steps=args.steps,
+            fsdp=fsdp, dp=dp))
+        reader = TelemetryReader(sink, every=args.telemetry_every)
+    monitor = GuardMonitor(sink=sink)
+    consensus_mon = ConsensusMonitor(sink=sink)
+
+    batch_n = max(args.batch, dp) // dp * dp
+    images = rng.normal(size=(4 * batch_n, feat)).astype(np.float32)
+    labels = rng.integers(0, classes, size=(4 * batch_n,)).astype(np.int32)
+
+    def repairs_by_group(st) -> list:
+        """Per-fsdp-group consensus repair counts. The AuditState is
+        replicated WITHIN each dp group (its whole jurisdiction) but a
+        repair in group 1 never bumps group 0's counter — reading one
+        device (audit_report) under-reports, so sum the per-group view."""
+        from grace_tpu.transform import GraceState
+        audits = []
+
+        def find(node):
+            if isinstance(node, GraceState) and node.audit is not None:
+                audits.append(node.audit)
+            return node
+
+        jax.tree_util.tree_map(find, st.opt_state,
+                               is_leaf=lambda n: isinstance(n, GraceState))
+        reps = audits[0].repairs
+        per_dev = {s.device: int(np.asarray(s.data).reshape(-1)[0])
+                   for s in reps.addressable_shards}
+        return [max(per_dev[mesh.devices[d, f]] for d in range(dp))
+                for f in range(fsdp)]
+
+    loss = float("nan")
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state = sdc(state, i)
+        lo = (i * batch_n) % len(images)
+        b = (jnp.asarray(images[lo:lo + batch_n]),
+             jnp.asarray(labels[lo:lo + batch_n]))
+        state, loss = step(state, b)
+        monitor.update(i, guard_report(state))
+        consensus_mon.update(i, audit_report(state))
+        if reader is not None:
+            reader.update(i, state)
+    loss = float(loss)
+    dt = time.perf_counter() - t0
+    if reader is not None:
+        reader.flush(state)
+        reader.close()
+
+    rep = guard_report(state)
+    arep = dict(audit_report(state))
+    group_repairs = repairs_by_group(state)
+    arep["repairs"] = sum(group_repairs)
+    # Replicas must be bit-identical PER FSDP SHARD: group every param
+    # leaf's device buffers by the global index window they cover (a
+    # replicated leaf has one window — all 8 buffers must agree; w1 has
+    # one window per fsdp shard — its dp replicas must agree within each).
+    variants = 0
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        groups: dict = {}
+        for s in leaf.addressable_shards:
+            key = str(s.index)
+            groups.setdefault(key, set()).add(
+                np.asarray(s.data).tobytes())
+        variants = max(variants, max(len(v) for v in groups.values()))
+    print(f"[chaos_smoke] fsdp: {args.steps} steps in {dt:.1f}s on "
+          f"dp{dp}×fsdp{fsdp} | final loss {loss:.4f} | skipped "
+          f"{rep['notfinite_count']} | injected {len(sdc.injections)} | "
+          f"repairs {arep['repairs']} (per fsdp group: {group_repairs}) | "
+          f"per-shard replica variants {variants}")
+
+    ok = True
+    if not np.isfinite(loss):
+        print("[chaos_smoke] FAIL: final loss non-finite over the 2-D "
+              "mesh", file=sys.stderr)
+        ok = False
+    if args.nan_prob and rep["notfinite_count"] == 0:
+        print("[chaos_smoke] FAIL: guard never tripped — injection is "
+              "not reaching the per-shard exchanges", file=sys.stderr)
+        ok = False
+    if arep["repairs"] < len(sdc.injections):
+        print(f"[chaos_smoke] FAIL: consensus repaired {arep['repairs']} "
+              f"of {len(sdc.injections)} injected corruptions over the "
+              "2-D mesh", file=sys.stderr)
+        ok = False
+    if variants > 1:
+        print("[chaos_smoke] FAIL: replicas still diverged within an "
+              "fsdp shard after the final audit window", file=sys.stderr)
+        ok = False
+    if args.telemetry_out:
+        import json as _json
+        split_rows = both_axes = 0
+        with open(args.telemetry_out) as f:
+            for line in f:
+                rec = _json.loads(line)
+                if "step" not in rec or "wire_bytes" not in rec:
+                    continue
+                if "wire_bytes_ici" in rec and "wire_bytes_dcn" in rec:
+                    split_rows += 1
+                    if rec["wire_bytes_dcn"] > 0 and \
+                            rec["wire_bytes_ici"] > 0:
+                        both_axes += 1
+        print(f"[chaos_smoke] fsdp: {split_rows} telemetry rows carry "
+              f"the per-link split ({both_axes} with bytes on BOTH "
+              "links)")
+        if not split_rows:
+            print("[chaos_smoke] FAIL: no telemetry row carries the "
+                  "two-axis wire split", file=sys.stderr)
+            ok = False
+    print("[chaos_smoke] OK" if ok else "[chaos_smoke] FAIL",
+          flush=True)
+    return 0 if ok else 1
 
 
 def _elastic_main(args) -> int:

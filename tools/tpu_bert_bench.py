@@ -1,12 +1,20 @@
-"""On-chip BERT-base + PowerSGD rank-4 bench row (VERDICT round-3 item 7).
+"""On-chip BERT-base bench rows: dense, PowerSGD r4, and the graft-shard
+transformer track (rscatter + per-leaf codec routing).
 
 BASELINE.json config 4 pairs "BERT-base SQuAD" with PowerSGD rank-4 over
-allreduce (reference grace_dl/dist/compressor/powersgd.py); the convergence
-example is examples/bert_powersgd.py, but no perf row existed. This measures
-the dense baseline and powersgd_r4 interleaved in ONE session — the same
-same-session discipline as bench.bench_configs — reporting tokens/sec,
-spread, and PowerSGD's analytic wire bytes (compressors/powersgd.py
-wire_nbytes). Rows persist row-by-row to BENCH_BERT_TPU_LAST.json
+allreduce (reference grace_dl/dist/compressor/powersgd.py); the committed
+capture has that row LOSING at 0.80× dense single-chip (the before-picture
+ROADMAP item 2 names). The graft-shard rows are the after-picture: Top-K 1%
+through the compressed per-shard reduce-scatter (``communicator:
+"rscatter"``), and the ROUTED config — embeddings and the big matrices
+ride sparsification, LayerNorm/bias leaves ride dense fp16 psum — whose
+per-link xslice projection is the test-pinned >1× vs dense at W≥64
+(tests/test_shard.py). All rows measure dense interleaved in ONE session —
+the same same-session discipline as bench.bench_configs — reporting
+tokens/sec, spread, per-leaf wire bytes (helper.route_leaves for routed
+rows), and per-link projections through the ONE shared wire model
+(helper.routed_recv_link_bytes — collapses to the plain model for
+unrouted rows). Rows persist row-by-row to BENCH_BERT_TPU_LAST.json
 (bench.progressive_emit), so a mid-run tunnel death keeps the dense row.
 
 Run by tools/tpu_watch.sh after the main sweep; manual:
@@ -29,6 +37,18 @@ import bench  # noqa: E402
 EVIDENCE_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "BENCH_BERT_TPU_LAST.json")
 
+# Transformer routing (ISSUE 14): LayerNorm scales/offsets and biases hate
+# sparsification and are a rounding error of the wire bill — they ride
+# dense fp16 psum; everything else (embeddings, qkv/proj/ff matrices — the
+# >99% of BERT's 108.8M params where wire bytes concentrate) rides chunked
+# Top-K 1% through the per-shard reduce-scatter.
+BERT_ROUTE = [("*ln*", {"compressor": "fp16", "memory": "none",
+                        "communicator": "allreduce"}),
+              ("*bias*", {"compressor": "fp16", "memory": "none",
+                          "communicator": "allreduce"}),
+              ("*/b", {"compressor": "fp16", "memory": "none",
+                       "communicator": "allreduce"})]
+
 CONFIGS = [
     # fusion "none", twice over: (a) like-for-like with the powersgd config
     # below (also per-leaf); (b) fusion "flat" on the 108.8M-element BERT
@@ -46,7 +66,82 @@ CONFIGS = [
                                             "memory": "powersgd",
                                             "communicator": "allreduce",
                                             "fusion": "none"}},
+    # graft-shard (ISSUE 14): the per-shard reduce-scatter — one
+    # all_to_all + one all_gather per leaf, requant chain 1 at any W.
+    {"name": "bert_topk1pct_rscatter",
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "communicator": "rscatter", "fusion": "none"}},
+    # ...and the routed config: the transformer-track headline shape.
+    {"name": "bert_routed_rscatter",
+     "params": {"compressor": "topk", "compress_ratio": 0.01,
+                "topk_algorithm": "chunk", "memory": "residual",
+                "communicator": "rscatter", "fusion": "none",
+                "route": BERT_ROUTE}},
 ]
+
+
+def routed_wire_report(grace, params):
+    """(wire_bytes, dense_bytes) summed per leaf through each leaf's own
+    routed codec — collapses to wire_report's totals for unrouted rows."""
+    import numpy as np
+
+    from grace_tpu.helper import route_leaves
+    from grace_tpu.utils.metrics import payload_nbytes
+
+    wire = dense = 0
+    for _p, s, comp, _m, _cm in route_leaves(grace, params):
+        ne = int(np.prod(s.shape, dtype=np.int64))
+        dense += ne * s.dtype.itemsize
+        wire += payload_nbytes(comp, s)
+    return wire, dense
+
+
+def project_routed(step_s: float, dense_step_s: float, grace, params,
+                   n_elems: int) -> list:
+    """Per-link multi-chip projection for a (possibly routed) config
+    through ``helper.routed_recv_link_bytes`` — the routed spelling of
+    ``bench.project_multichip``, same worlds, same bandwidth constants,
+    same NO-OVERLAP convention, dense priced through the identical shared
+    model."""
+    from grace_tpu.comm import Allreduce
+    from grace_tpu.core import Topology
+    from grace_tpu.helper import routed_recv_link_bytes
+
+    dense_comm = Allreduce()
+    dense_b = sum(x.size * x.dtype.itemsize
+                  for x in __import__("jax").tree_util.tree_leaves(params))
+    xtopo = Topology(slice_size=bench.XSLICE_CHIPS)
+    out = []
+    for w in bench.PROJECTION_WORLDS:
+        cfg_recv = routed_recv_link_bytes(grace, params, w).total
+        dense_recv = dense_comm.recv_wire_bytes(dense_b, n_elems, w)
+        row = {"world": w, "recv_bytes_per_rank": cfg_recv}
+        for net, bw in (("ici", bench.ICI_RING_BYTES_PER_S),
+                        ("dcn", bench.DCN_BYTES_PER_S)):
+            t_cfg = step_s + cfg_recv / bw
+            t_dense = dense_step_s + dense_recv / bw
+            row[f"step_ms_{net}"] = round(t_cfg * 1e3, 3)
+            row[f"speedup_vs_dense_{net}"] = round(t_dense / t_cfg, 3)
+        cfg_link = routed_recv_link_bytes(grace, params, w, topology=xtopo)
+        dense_link = dense_comm.recv_link_bytes(dense_b, n_elems, w,
+                                                topology=xtopo)
+
+        def t_split(base_s, link):
+            return (base_s + link.ici / bench.ICI_RING_BYTES_PER_S
+                    + link.dcn / bench.DCN_BYTES_PER_S)
+
+        t_cfg = t_split(step_s, cfg_link)
+        row["xslice"] = {
+            "slice_size": bench.XSLICE_CHIPS,
+            "ici_bytes": cfg_link.ici,
+            "dcn_bytes": cfg_link.dcn,
+            "step_ms": round(t_cfg * 1e3, 3),
+            "speedup_vs_dense": round(
+                t_split(dense_step_s, dense_link) / t_cfg, 3),
+        }
+        out.append(row)
+    return out
 
 
 def run(platform: str, emit) -> None:
@@ -63,7 +158,6 @@ def run(platform: str, emit) -> None:
     from grace_tpu.parallel import batch_sharded, data_parallel_mesh
     from grace_tpu.train import (init_stateful_train_state,
                                  make_stateful_train_step)
-    from grace_tpu.utils import wire_report
 
     on_tpu = devices[0].platform == "tpu"
     mesh = data_parallel_mesh(devices)
@@ -111,50 +205,43 @@ def run(platform: str, emit) -> None:
           f"({chip}), seq={seq}, bs={per_device_bs}/device",
           file=sys.stderr, flush=True)
 
-    base_step, base_ts, base_grace, base_params = build(CONFIGS[0]["params"])
-    comp_step, comp_ts, comp_grace, comp_params = build(CONFIGS[1]["params"])
-
-    bsamples, csamples = [], []
+    built = [build(c["params"]) for c in CONFIGS]
+    samples = [[] for _ in CONFIGS]
     for r in range(repeats):
         warm = 4 if r == 0 else 2
-        s, base_ts = bench.throughput(base_step, base_ts, batch, n_batches,
-                                      warmup=warm)
-        bsamples.append(s)
-        s, comp_ts = bench.throughput(comp_step, comp_ts, batch, n_batches,
-                                      warmup=warm)
-        csamples.append(s)
+        for j, (step, ts, _g, _p) in enumerate(built):
+            s, ts = bench.throughput(step, ts, batch, n_batches,
+                                     warmup=warm)
+            built[j] = (step, ts, built[j][2], built[j][3])
+            samples[j].append(s)
 
     med = statistics.median
-    n_elems = sum(x.size for x in jax.tree_util.tree_leaves(base_params))
-    for name, samples, other, grace, params in (
-            ("bert_dense", bsamples, bsamples, base_grace, base_params),
-            ("bert_powersgd_r4", csamples, bsamples, comp_grace,
-             comp_params)):
-        seqs = med(samples)
-        rep = wire_report(grace.compressor, params)
-        spread = (100.0 * (max(samples) - min(samples)) / seqs
-                  if seqs else 0.0)
-        vote = getattr(grace.compressor, "vote_aggregate", False)
+    base_samples = samples[0]
+    n_elems = sum(x.size for x in jax.tree_util.tree_leaves(built[0][3]))
+    for c, (step, ts, grace, params), ss in zip(CONFIGS, built, samples):
+        seqs = med(ss)
+        wire_b, dense_b = routed_wire_report(grace, params)
+        spread = (100.0 * (max(ss) - min(ss)) / seqs if seqs else 0.0)
+        from grace_tpu.helper import routed_recv_link_bytes
         emit({
-            "config": name,
+            "config": c["name"],
             "tokens_per_sec": round(seqs * seq, 1),
             "seqs_per_sec": round(seqs, 2),
-            "samples_seqs_per_sec": [round(s, 2) for s in samples],
+            "samples_seqs_per_sec": [round(s, 2) for s in ss],
             "spread_pct": round(spread, 2),
-            "vs_baseline": round(seqs / med(other), 4),
+            "vs_baseline": round(seqs / med(base_samples), 4),
             "same_session": True,
             "seq_len": seq,
             "per_device_bs": per_device_bs,
             "model": "bert-base" if on_tpu else "bert-tiny(smoke)",
             "n_params": n_elems,
-            "wire_bytes_per_step": rep.wire_bytes,
-            "wire_ratio": round(rep.ratio, 6),
-            "wire_recv_bytes_per_step": bench.recv_bytes_model(
-                grace.communicator, vote, rep.wire_bytes, n_elems,
-                len(devices)),
-            "projection": bench.project_multichip(
-                n / seqs, n / med(bsamples), grace, rep.wire_bytes,
-                rep.dense_bytes, n_elems),
+            "routed": bool(c["params"].get("route")),
+            "wire_bytes_per_step": wire_b,
+            "wire_ratio": round(wire_b / max(1, dense_b), 6),
+            "wire_recv_bytes_per_step": routed_recv_link_bytes(
+                grace, params, len(devices)).total,
+            "projection": project_routed(
+                n / seqs, n / med(base_samples), grace, params, n_elems),
             "platform": devices[0].platform,
             "n_devices": len(devices),
             "chip": chip,
