@@ -168,6 +168,23 @@ CONFIGS = [
      "params": {"compressor": "homoqsgd", "quantum_num": 7,
                 "memory": "residual", "communicator": "hier",
                 "slice_size": 8, "fusion": "flat"}},
+    # graft-adapt row (ISSUE 15): the self-tuning homoqsgd ladder (dense
+    # escape → 8-bit → 4-bit) over the zero-requant ring, measured at its
+    # quiet steady state — the top rung IS homoqsgd4_ring_bs256's codec,
+    # so this row's delta against that one is the controller's whole
+    # overhead bill (the per-step scalar pmean/pmax signal + the ladder
+    # switch + the telemetry ring). The acceptance claim is ~parity:
+    # a self-tuning config matching the best static config's steady-state
+    # throughput (the convergence-floor half lives in tests/test_adapt).
+    {"name": "adapt_homoqsgd4_ring_bs256", "per_device_bs": 256,
+     "note": "self-tuning ladder (dense->homoqsgd8->homoqsgd4) at its "
+             "steady state; compare against homoqsgd4_ring_bs256 for "
+             "the controller overhead",
+     "params": {"compressor": "homoqsgd", "quantum_num": 7,
+                "memory": "residual", "communicator": "ring",
+                "fusion": "flat", "escape": "fp16", "telemetry": 16,
+                "adapt": {"window": 25,
+                          "ladder": [{"quantum_num": 127}]}}},
     # The overdue graft-tune chip-window row (ISSUE 12 / ROADMAP item 1):
     # everything PRs 7-10 built, on in one config — fused Pallas
     # quantize-and-pack (4-bit nibbles, 2 codes/byte) feeding the bucketed
@@ -345,7 +362,13 @@ TUNED_ROW_NAMES = ("none", "topk1pct", "topk1pct_hier_bs256", "qsgd_hier",
                    # graft-shard (ISSUE 14): the rscatter schedule now
                    # tops the W256/slice8 static ranking — its measured
                    # step time is the next capture's most-wanted row
-                   "topk1pct_rscatter_bs256")
+                   "topk1pct_rscatter_bs256",
+                   # graft-adapt (ISSUE 15): the self-tuning ladder at
+                   # its steady state next to its static twin — the
+                   # controller-overhead ablation the acceptance
+                   # criterion ("matches the best static config's
+                   # steady-state throughput") needs on-chip
+                   "adapt_homoqsgd4_ring_bs256")
 
 
 def active_configs():

@@ -302,6 +302,45 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
                         "topk_algorithm": "chunk", "memory": "residual",
                         "communicator": "hier", "slice_size": 4,
                         "fusion": "flat", "telemetry": True, "watch": 5}),
+    # -- graft-adapt variants (ISSUE 15): the in-graph adaptive controller
+    #    — a lax.switch over the WHOLE degradation ladder (branch 0 the
+    #    dense escape psum, branch r the rung-r codec's full schedule)
+    #    whose index derives from replicated policy state + the replicated
+    #    fallback flag, plus the per-step scalar pmean/pmax signal
+    #    reductions. These entries are the standing proof pass 1 blesses
+    #    the legal version of EXACTLY the shape it exists to condemn
+    #    (branch-divergent collective sequences under a predicate), and
+    #    flow pass 6 audits every reachable rung's payload contract —
+    #    including each shared-scale rung's payload_sum_max_world bound.
+    #    Wire reconciliation is excluded like every escape-carrying entry:
+    #    the ladder makes "the" wire cost R-modal by design (telemetry
+    #    prices the flip per rung instead).
+    _cfg("adapt-homoqsgd-ring",
+         {"compressor": "homoqsgd", "quantum_num": 7, "memory": "residual",
+          "communicator": "ring", "fusion": "flat", "escape": "fp16",
+          "telemetry": True,
+          "adapt": {"window": 5, "ladder": [{"quantum_num": 127}]}},
+         passes=_NO_WIRE),
+    _cfg("adapt-topk-hier",
+         {"compressor": "topk", "compress_ratio": 0.01,
+          "topk_algorithm": "chunk", "memory": "residual",
+          "communicator": "hier", "slice_size": 4, "fusion": "flat",
+          "escape": "fp16", "telemetry": True,
+          "adapt": {"window": 5, "ladder": [{"compress_ratio": 0.04}]}},
+         passes=_NO_WIRE),
+    # The controller under the full resilience stack: the guard's psum-OR
+    # feeds the fallback flag that forces rung 0, the consensus audit
+    # fingerprints (and would repair) the replicated AdaptState, and the
+    # ladder switch nests inside the guarded train step — every
+    # replicated-predicate argument graft-adapt makes, verified in one
+    # trace.
+    _cfg("adapt-guard-consensus",
+         {"compressor": "topk", "compress_ratio": 0.05,
+          "memory": "residual", "communicator": "allgather",
+          "escape": "fp16", "telemetry": True, "consensus": True,
+          "adapt": {"window": 5, "ladder": [{"compress_ratio": 0.2}]}},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
     # -- resilience variants: the conds the auditor exists for --------------
     _cfg("topk-escape-telemetry",
          {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",

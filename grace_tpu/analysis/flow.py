@@ -527,12 +527,34 @@ def _codec_payload_structs(traced: TracedGraph):
     return [(n, s) for n, s, _comp in _codec_payload_entries(traced)]
 
 
+def _rung_compressors(grace) -> List[Any]:
+    """Every codec the config can actually run an exchange with: the base
+    compressor alone for static configs, or — for a graft-adapt config —
+    every non-dense rung of the declared degradation ladder (the base is
+    the top rung by :func:`grace_tpu.resilience.adapt.normalize_adapt`'s
+    contract; the dense rung 0 is the escape codec, covered by the
+    traced-graph analyses directly). This is what "audit every reachable
+    ladder rung" means mechanically: the payload-contract checks below
+    iterate it."""
+    adapt = getattr(grace, "adapt", None)
+    ladder = tuple(getattr(adapt, "ladder", ()) or ())
+    if not ladder:
+        return [getattr(grace, "compressor", None)]
+    out: List[Any] = []
+    for comp in (getattr(grace, "compressor", None),) + ladder:
+        if comp is not None and comp not in out:
+            out.append(comp)
+    return out
+
+
 def _codec_payload_entries(traced: TracedGraph):
     """``(n_elems, struct, compressor)`` per compress call: the fusion
     enumeration with the codec that actually encodes each call — for a
     ROUTED config the compressor differs per leaf (the per-leaf route
-    table), so the index-dtype and pack-width contracts are checked
-    against each leaf's own codec."""
+    table), and for a graft-adapt config EVERY reachable ladder rung
+    contributes its own entries, so the index-dtype and pack-width
+    contracts are checked against each codec the traced switch can
+    dispatch to."""
     from grace_tpu.transform import fusion_payload_structs
 
     grace = traced.meta.get("grace")
@@ -547,8 +569,8 @@ def _codec_payload_entries(traced: TracedGraph):
                 for _p, s, comp, _m, _cm in route_leaves(grace, named)]
     structs = _param_structs(traced)
     fusion = getattr(grace, "fusion", None)
-    comp = getattr(grace, "compressor", None)
     return [(int(np.prod(s.shape, dtype=np.int64)), s, comp)
+            for comp in _rung_compressors(grace)
             for s, _count in fusion_payload_structs(structs, fusion)]
 
 
@@ -689,9 +711,6 @@ def _shared_scale_findings(traced: TracedGraph) -> List[Finding]:
     grace = traced.meta.get("grace")
     if grace is None:
         return []
-    comp = grace.compressor
-    if getattr(comp, "payload_algebra", None) != "shared_scale":
-        return []
     # Only the payload-summing schedules accumulate W levels in the wire
     # dtype; a gather decodes per rank and never sums payloads.
     if not isinstance(grace.communicator,
@@ -699,22 +718,32 @@ def _shared_scale_findings(traced: TracedGraph) -> List[Finding]:
                        comm.ReduceScatterAllreduce,
                        comm.HierarchicalAllreduce)):
         return []
-    bound = comp.payload_sum_max_world()
-    if bound is None or traced.world <= bound:
-        return []
-    return [Finding(
-        pass_name="numeric_safety", config=traced.name,
-        severity="error", stage=STAGE_EXCHANGE,
-        message=(
-            f"{type(comp).__name__} payload-space sum spans "
-            f"world={traced.world} ranks but its integer accumulator "
-            f"carries exact sums only up to world {bound} "
-            "(payload_sum_max_world: iinfo(accum_dtype).max // max level "
-            "— the same constant the communicators' runtime gate "
-            "enforces); beyond it level sums wrap with no NaN/inf for "
-            "the guard to catch — widen accum_dtype or lower quantum_num"),
-        details=(("payload_sum_max_world", int(bound)),
-                 ("world", traced.world)))]
+    findings: List[Finding] = []
+    # Every reachable codec — for a graft-adapt config that is EVERY
+    # ladder rung: the controller can dispatch any of them mid-run, so a
+    # single rung whose accumulator cannot cover the world is a
+    # reachable silent-wrap state, not a hypothetical.
+    for comp in _rung_compressors(grace):
+        if getattr(comp, "payload_algebra", None) != "shared_scale":
+            continue
+        bound = comp.payload_sum_max_world()
+        if bound is None or traced.world <= bound:
+            continue
+        findings.append(Finding(
+            pass_name="numeric_safety", config=traced.name,
+            severity="error", stage=STAGE_EXCHANGE,
+            message=(
+                f"{type(comp).__name__} payload-space sum spans "
+                f"world={traced.world} ranks but its integer accumulator "
+                f"carries exact sums only up to world {bound} "
+                "(payload_sum_max_world: iinfo(accum_dtype).max // max "
+                "level — the same constant the communicators' runtime "
+                "gate enforces); beyond it level sums wrap with no "
+                "NaN/inf for the guard to catch — widen accum_dtype or "
+                "lower quantum_num"),
+            details=(("payload_sum_max_world", int(bound)),
+                     ("world", traced.world))))
+    return findings
 
 
 def pass_numeric_safety(traced: TracedGraph) -> List[Finding]:

@@ -68,6 +68,14 @@ class Grace:
                            # codec routing — embeddings ride aggressive
                            # sparsification while LayerNorm/bias leaves
                            # ride dense/fp16. Set from params["route"].
+    adapt: Any = None      # None | resilience.adapt.AdaptConfig with the
+                           # BUILT rung compressors (base codec as the
+                           # top rung): the graft-adapt in-graph
+                           # degradation ladder. Set from
+                           # params["adapt"]; requires escape+telemetry.
+                           # Stored normalized so the static auditor and
+                           # the tuner enumerate the same rungs the
+                           # transform dispatches over.
 
     def transform(self, seed: int = 0) -> optax.GradientTransformation:
         return grace_transform(self.compressor, self.memory,
@@ -78,7 +86,8 @@ class Grace:
                                topology=self.topology,
                                watch=self.watch,
                                mesh=self.mesh,
-                               routes=self.routes or None)
+                               routes=self.routes or None,
+                               adapt=self.adapt)
 
 
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
@@ -223,6 +232,21 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
     layout (:class:`grace_tpu.transform.MeshSpec`); the communicator's
     exchange stays the per-shard reduce over ``axis_name``.
 
+    ``adapt`` (grace-tpu extension): the graft-adapt in-graph adaptive
+    compression controller (:mod:`grace_tpu.resilience.adapt`). ``True``
+    / int ``window`` / dict of :class:`AdaptConfig` kwargs where
+    ``ladder`` is a list of *override dicts* — each merged over this
+    config's own params (minus adapt/route) and built into a rung codec,
+    safest first; this config's own compressor is always the top
+    (steady-state) rung and the dense escape is rung 0. Requires
+    ``escape`` and ``telemetry``. Example — a homoqsgd bit-width ladder
+    (dense → 8-bit → 4-bit)::
+
+        {"compressor": "homoqsgd", "quantum_num": 7,
+         "memory": "residual", "communicator": "ring", "fusion": "flat",
+         "escape": "fp16", "telemetry": True,
+         "adapt": {"window": 20, "ladder": [{"quantum_num": 127}]}}
+
     ``route`` (grace-tpu extension): ``[(pattern, overrides), ...]`` —
     first-class per-leaf codec routing. Each ``overrides`` dict is merged
     over this config's own params (minus the route itself) and built into
@@ -276,6 +300,36 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
             sub_entries.append((str(pattern), grace_from_params(merged)))
         routes = normalize_routes(
             sub_entries, _build_communicator(params, axis))
+    adapt_cfg = None
+    if params.get("adapt"):
+        from grace_tpu.resilience.adapt import AdaptConfig, normalize_adapt
+
+        spec = params["adapt"]
+        base_comp = _build_compressor(params, axis)
+        if isinstance(spec, AdaptConfig):
+            adapt_cfg = normalize_adapt(spec, base_comp)
+        else:
+            if spec is True:
+                kwargs: Dict[str, Any] = {}
+            elif isinstance(spec, int):
+                kwargs = {"window": spec}
+            elif isinstance(spec, dict):
+                kwargs = dict(spec)
+            else:
+                raise TypeError(
+                    f"adapt must be True/int/dict/AdaptConfig; got "
+                    f"{type(spec).__name__}")
+            # Ladder entries are override dicts merged over this config's
+            # own params (the route idiom): each builds one rung codec,
+            # safest first; the base codec becomes the top rung.
+            rungs = []
+            for overrides in kwargs.pop("ladder", ()):
+                merged = {k: v for k, v in params.items()
+                          if k not in ("adapt", "route")}
+                merged.update(dict(overrides))
+                rungs.append(_build_compressor(merged, axis))
+            adapt_cfg = normalize_adapt(
+                AdaptConfig(ladder=tuple(rungs), **kwargs), base_comp)
     return Grace(compressor=_build_compressor(params, axis),
                  memory=_build_memory(params, axis),
                  communicator=_build_communicator(params, axis),
@@ -293,7 +347,8 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
                  consensus=params.get("consensus"),
                  # True | window | {"window": .., "capacity": ..} — see
                  # grace_transform(watch=) / telemetry.aggregate
-                 watch=params.get("watch"))
+                 watch=params.get("watch"),
+                 adapt=adapt_cfg)
 
 
 def route_leaves(grace: Grace, tree):

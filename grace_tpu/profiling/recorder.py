@@ -140,7 +140,7 @@ def grace_state_footprint(tree) -> Dict[str, int]:
             # exactly like telem does.
             telem += _tree_nbytes((node.telem, node.watch))
             book += _tree_nbytes((node.count, node.rng_key, node.fallback,
-                                  node.audit))
+                                  node.audit, node.adapt))
         return node
 
     jax.tree_util.tree_map(visit, tree,

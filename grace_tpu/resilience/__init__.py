@@ -26,6 +26,11 @@ it (SURVEY.md has no counterpart — the reference assumes a fault-free run):
   (replicated fields carried bit-exactly, per-rank residuals/rings
   re-initialized at the new W), slice-granular hierarchical shrink, and
   the consensus-gated rejoin barrier.
+* :mod:`~grace_tpu.resilience.adapt` — the graft-adapt in-graph adaptive
+  compression controller: a replicated degradation ladder between the
+  static codec and the dense escape, tightening within one window of an
+  error spike (before the guard would trip) and loosening with
+  hysteresis when gradients go quiet.
 """
 
 from __future__ import annotations
@@ -34,6 +39,9 @@ from typing import Optional
 
 import optax
 
+from grace_tpu.resilience.adapt import (AdaptConfig, AdaptMonitor,
+                                        AdaptState, adapt_report,
+                                        normalize_adapt)
 from grace_tpu.resilience.chaos import (ChaosCommunicator, ChaosCompressor,
                                         ChaosParams)
 from grace_tpu.resilience.consensus import (ConsensusConfig, audit_report,
@@ -52,7 +60,9 @@ __all__ = ["GuardState", "guard_transform", "guarded_chain",
            "force_audit", "audit_report", "normalize_consensus",
            "ElasticController", "ResizePlan", "plan_resize",
            "reshard_grace_state", "validate_resharded", "rejoin_barrier",
-           "implant_stale_replica", "replica_variants"]
+           "implant_stale_replica", "replica_variants",
+           "AdaptConfig", "AdaptState", "AdaptMonitor", "adapt_report",
+           "normalize_adapt"]
 
 
 def guarded_chain(grace, *txs: optax.GradientTransformation,

@@ -1,0 +1,392 @@
+"""graft-adapt: in-graph adaptive compression controller.
+
+The resilience stack before this module was binary: a config either runs
+its static codec, or the PR-1 guard slams it into the M-step dense
+fallback. ROADMAP item 3 asks for the middle rungs — a controller that
+*degrades gracefully*, tightening codec aggressiveness while the gradient
+signal is turbulent (warmup, error spikes, a single rank's encoder
+drifting) and loosening back toward the aggressive steady-state codec when
+things go quiet. Both halves of the loop already exist: graft-watch's
+replicated cross-rank error columns are the input channel, and the
+PR-13 aggregation-homomorphic payloads make codec swaps cheap mid-run
+(THC, PAPERS.md — bit-width switching over shared-scale payloads needs no
+state migration; ACCORDION shows the rate-schedule side). This module is
+the missing actuator.
+
+**The degradation ladder.** An :class:`AdaptConfig` declares an ordered
+tuple of codecs from safest to most aggressive; rung 0 is always the dense
+escape (``grace_transform(escape=...)`` — the same codec+psum the guard's
+fallback window uses), rungs ``1..R-1`` are the declared
+:attr:`~AdaptConfig.ladder` (e.g. homoqsgd 8 bits → 4 bits, topk ratio
+×4 → ×1), and the transform's own base codec is always the top rung — the
+steady state a quiet run converges to. Every update executes exactly one
+rung via ``lax.switch`` on the replicated rung index, so the whole ladder
+is one compiled program and every rung's schedule is statically traced
+(and therefore statically audited — flow pass 6 sees every reachable
+rung, including each shared-scale rung's ``payload_sum_max_world`` bound).
+
+**The controller is a replicated lax.cond, not a host loop.** Every step,
+each rank's local relative compression error (the telemetry ring's
+``compression_error`` scalar, computed against the *active* rung's codec)
+is reduced cross-rank with one scalar ``pmean`` + one scalar ``pmax`` —
+the graft-watch gather idiom at scalar size, so the windowed signal is a
+*replicated in-graph fact*: every rank provably accumulates the same
+``err_sum``/``err_peak``, and the window-boundary decision (a ``lax.cond``
+on the replicated step counter, exactly the consensus/watch gate) moves
+every rank's rung identically. graft-lint's collective-consistency pass
+verifies the branch-divergent ``lax.switch`` predicate is replicated —
+the same proof obligation the dense-escape cond discharges.
+
+**Robustness-first semantics**:
+
+* **tighten before the guard would trip** — a spike in the windowed mean
+  (``tighten_error``) or in the worst rank's error (``tighten_peak`` —
+  the drifting-rank channel graft-watch flags) steps DOWN one rung within
+  one window;
+* **hysteresis** — loosening requires ``quiet_windows`` consecutive quiet
+  windows (windowed mean below ``loosen_error`` < ``tighten_error``), so
+  the controller probes back up slowly and can never flap at window rate;
+* **a guard trip is evidence the ladder floor is too loose**
+  (escalate-and-hold) — any step spent under the guard's fallback flag
+  tightens one extra rung at the next boundary AND arms a
+  ``hold_windows``-window freeze on loosening;
+* **atomic with guard rollback and consensus repair** — the policy state
+  (:class:`AdaptState`) lives in ``GraceState.adapt``, replicated
+  (``partition_specs`` P(), fingerprinted by the consensus audit, repaired
+  by the masked broadcast, rolled back bitwise by the guard), and a world
+  resize re-initializes it (:func:`grace_tpu.resilience.elastic.
+  reshard_grace_state`) — the windowed statistics and operating rung were
+  learned at the old world's signal profile.
+
+Wire honesty: telemetry prices the state-dependent bytes with a per-rung
+wire plan (the dense-fallback flip generalized — ``adapt_rung`` names the
+rung each row's ``wire_bytes``/ici/dcn were priced at) and the signal
+reductions' cost is surfaced as ``adapt_bytes``, folded into the effective
+wire accounting like ``watch_bytes``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["AdaptConfig", "AdaptState", "normalize_adapt", "adapt_init",
+           "adapt_signal", "adapt_signal_bytes", "adapt_advance",
+           "adapt_report", "AdaptMonitor"]
+
+# Non-finite local errors (a poisoned gradient the guard will roll back
+# anyway) clamp to this finite spike so the accumulators stay finite and
+# the boundary decision reads "tighten", never NaN-poisons the policy.
+_ERR_CLAMP = 1e6
+
+
+class AdaptState(NamedTuple):
+    """Replicated controller state, threaded through ``GraceState.adapt``.
+
+    Every field is a scalar derived from replicated inputs (the step
+    counter, the fallback flag, and full-axis pmean/pmax outputs), so all
+    ranks hold bit-identical policy state — which is what lets the
+    ``lax.switch`` rung dispatch stay deadlock-free, the consensus audit
+    fingerprint it, and the masked-broadcast repair restore it.
+    """
+
+    rung: jax.Array          # int32: commanded rung (0 = dense escape)
+    err_sum: jax.Array       # f32: window sum of replicated mean rel error
+    err_peak: jax.Array      # f32: window max of worst-rank rel error
+    fb_steps: jax.Array      # int32: steps this window spent under the
+                             # guard's fallback flag (the escalate evidence)
+    quiet: jax.Array         # int32: consecutive quiet windows
+    hold: jax.Array          # int32: loosen-freeze windows remaining
+    tightens: jax.Array      # int32: total tighten transitions
+    loosens: jax.Array       # int32: total loosen transitions
+    escalations: jax.Array   # int32: guard-evidence escalate-and-holds
+    last_change_step: jax.Array  # int32: GraceState.count at last move, -1
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptConfig:
+    """Static controller knobs + the declared degradation ladder.
+
+    ``ladder`` — the non-dense rungs as built :class:`~grace_tpu.core.
+    Compressor` instances, safest first, most aggressive (the steady
+    state) last; the transform's base codec is always the top rung
+    (:func:`normalize_adapt` appends it when missing), and rung 0 — the
+    dense escape — is implicit. Every rung must thread the same mem/comp
+    state structure as the base codec (the ``lax.switch`` branches return
+    one state type; a PowerSGD rank ladder, whose Q factor changes shape
+    per rung, is rejected with a clear error at trace time).
+
+    ``window`` — steps between decisions (the ``lax.cond`` gate on the
+    replicated step counter, the consensus/watch idiom).
+    ``tighten_error``/``tighten_peak`` — windowed mean / worst-rank
+    relative-compression-error thresholds above which the controller
+    steps down one rung at the boundary. ``loosen_error`` — the quiet
+    threshold (must sit strictly below ``tighten_error``: that gap IS the
+    hysteresis band). ``quiet_windows`` — consecutive quiet windows
+    required before loosening one rung. ``hold_windows`` — loosen freeze
+    armed by guard-trip evidence (escalate-and-hold).
+    ``start_rung`` — initial rung (default: the top — start aggressive,
+    tighten on evidence; set lower for warmup-cautious runs).
+    """
+
+    ladder: Tuple[Any, ...] = ()
+    window: int = 10
+    tighten_error: float = 0.5
+    tighten_peak: float = 0.75
+    loosen_error: float = 0.25
+    quiet_windows: int = 2
+    hold_windows: int = 4
+    start_rung: Optional[int] = None
+
+    def __post_init__(self):
+        if self.window < 1:
+            raise ValueError(f"adapt window must be >= 1; got {self.window}")
+        if not (0.0 < self.loosen_error < self.tighten_error):
+            raise ValueError(
+                f"adapt thresholds must satisfy 0 < loosen_error "
+                f"({self.loosen_error}) < tighten_error "
+                f"({self.tighten_error}) — the gap between them is the "
+                "hysteresis band; equal thresholds would let the "
+                "controller flap a rung per window")
+        if self.tighten_peak < self.tighten_error:
+            raise ValueError(
+                f"tighten_peak ({self.tighten_peak}) must be >= "
+                f"tighten_error ({self.tighten_error}) — the worst-rank "
+                "channel is a coarser alarm than the mean, not a finer "
+                "one")
+        if self.quiet_windows < 1:
+            raise ValueError(f"quiet_windows must be >= 1; "
+                             f"got {self.quiet_windows}")
+        if self.hold_windows < 0:
+            raise ValueError(f"hold_windows must be >= 0; "
+                             f"got {self.hold_windows}")
+
+    @property
+    def n_rungs(self) -> int:
+        """Total reachable rungs including the implicit dense rung 0."""
+        return len(self.ladder) + 1
+
+    @property
+    def top_rung(self) -> int:
+        return len(self.ladder)
+
+
+def normalize_adapt(adapt, base_compressor) -> Optional[AdaptConfig]:
+    """Accept the ergonomic spellings of the adapt knob, mirroring
+    telemetry/consensus/watch: None/False (off), True (defaults), int
+    (window), dict (config kwargs; ``ladder`` holds built Compressor
+    instances), or an AdaptConfig. The transform's base codec is appended
+    as the ladder's top rung when the declared ladder does not already end
+    with it — the steady state is always the config's own codec."""
+    if adapt is None or adapt is False:
+        return None
+    if adapt is True:
+        cfg = AdaptConfig()
+    elif isinstance(adapt, AdaptConfig):
+        cfg = adapt
+    elif isinstance(adapt, int):
+        cfg = AdaptConfig(window=adapt)
+    elif isinstance(adapt, dict):
+        cfg = AdaptConfig(**{k: (tuple(v) if k == "ladder" else v)
+                             for k, v in adapt.items()})
+    else:
+        raise TypeError(f"adapt must be None/bool/int/dict/AdaptConfig; "
+                        f"got {type(adapt).__name__}")
+    ladder = tuple(cfg.ladder)
+    if not ladder or ladder[-1] != base_compressor:
+        ladder = ladder + (base_compressor,)
+    cfg = dataclasses.replace(cfg, ladder=ladder)
+    if cfg.start_rung is not None and not (0 <= cfg.start_rung
+                                           <= cfg.top_rung):
+        raise ValueError(
+            f"start_rung {cfg.start_rung} outside the ladder's rung range "
+            f"[0, {cfg.top_rung}]")
+    return cfg
+
+
+def adapt_init(config: AdaptConfig) -> AdaptState:
+    zero = jnp.zeros((), jnp.int32)
+    start = (config.start_rung if config.start_rung is not None
+             else config.top_rung)
+    return AdaptState(
+        rung=jnp.asarray(start, jnp.int32),
+        err_sum=jnp.zeros((), jnp.float32),
+        err_peak=jnp.zeros((), jnp.float32),
+        fb_steps=zero, quiet=zero, hold=zero,
+        tightens=zero, loosens=zero, escalations=zero,
+        last_change_step=zero - 1)
+
+
+def adapt_signal(local_err, axis_name: str):
+    """The controller's one collective pair: replicated (mean, worst-rank)
+    of each rank's local relative compression error — one scalar ``pmean``
+    + one scalar ``pmax`` per step, the graft-watch gather idiom at scalar
+    size. Outside a bound mesh axis (single-process use) the local value
+    stands in for both."""
+    err = jnp.asarray(local_err, jnp.float32)
+    try:
+        return lax.pmean(err, axis_name), lax.pmax(err, axis_name)
+    except NameError:               # unbound axis: no mesh, no peers
+        return err, err
+
+
+def adapt_signal_bytes(world: int) -> int:
+    """Per-rank received bytes of one step's signal reductions (one f32
+    pmean + one f32 pmax, each a full-axis ring reduction moving
+    ``2·n·(W−1)/W``) — the number folded into the telemetry row's
+    effective wire accounting as ``adapt_bytes``, and the number the
+    auditor's traced-collective count sees (well inside the scalar
+    atol)."""
+    return 2 * (2 * 4 * max(0, world - 1) // max(1, world))
+
+
+def adapt_advance(state: AdaptState, config: AdaptConfig, count,
+                  fallback, err_mean, err_peak) -> AdaptState:
+    """One step of the controller: accumulate the replicated window signal
+    every step; on the window boundary (``lax.cond`` on the replicated
+    step counter) decide the next rung. Pure state math — the branches
+    carry no collectives; the signal reductions already ran in
+    :func:`adapt_signal`."""
+    clamp = jnp.asarray(_ERR_CLAMP, jnp.float32)
+    em = jnp.minimum(jnp.nan_to_num(
+        jnp.asarray(err_mean, jnp.float32),
+        nan=_ERR_CLAMP, posinf=_ERR_CLAMP, neginf=_ERR_CLAMP), clamp)
+    ep = jnp.minimum(jnp.nan_to_num(
+        jnp.asarray(err_peak, jnp.float32),
+        nan=_ERR_CLAMP, posinf=_ERR_CLAMP, neginf=_ERR_CLAMP), clamp)
+    fb = jnp.asarray(fallback, jnp.bool_).astype(jnp.int32)
+    state = state._replace(err_sum=state.err_sum + em,
+                           err_peak=jnp.maximum(state.err_peak, ep),
+                           fb_steps=state.fb_steps + fb)
+    due = jnp.equal(jnp.mod(count + 1, config.window), 0)
+    return lax.cond(due, lambda s: _decide(s, config, count),
+                    lambda s: s, state)
+
+
+def _decide(a: AdaptState, config: AdaptConfig, count) -> AdaptState:
+    one = jnp.ones((), jnp.int32)
+    top = jnp.asarray(config.top_rung, jnp.int32)
+    wmean = a.err_sum / jnp.asarray(float(config.window), jnp.float32)
+
+    # Tighten: a windowed mean spike, a worst-rank spike (the drifting-rank
+    # channel), or guard-trip evidence — each steps DOWN one rung, within
+    # one window of the symptom.
+    spike = (wmean > config.tighten_error) | (a.err_peak
+                                              > config.tighten_peak)
+    guard_evidence = a.fb_steps > 0
+    tighten = spike | guard_evidence
+    rung = jnp.where(tighten, jnp.maximum(a.rung - one, 0), a.rung)
+
+    # Escalate-and-hold: a guard trip says the ladder floor was too loose
+    # — freeze loosening for the next hold_windows boundaries; otherwise
+    # the hold decays one per boundary. The loosen check below reads the
+    # PRE-decay hold, so hold_windows means hold_windows FULL frozen
+    # windows after the escalation boundary.
+    hold = jnp.where(guard_evidence,
+                     jnp.asarray(config.hold_windows, jnp.int32),
+                     jnp.maximum(a.hold - one, 0))
+
+    # Hysteresis: quiet windows accumulate only below loosen_error (which
+    # sits strictly below tighten_error), and loosening needs
+    # quiet_windows of them with no hold in force.
+    quiet_now = (~tighten) & (wmean < config.loosen_error)
+    quiet = jnp.where(tighten, 0, jnp.where(quiet_now, a.quiet + one, 0))
+    loosen = ((~tighten) & (quiet >= config.quiet_windows)
+              & (a.hold == 0) & (rung < top))
+    rung = jnp.where(loosen, rung + one, rung)
+    quiet = jnp.where(loosen, 0, quiet)
+
+    moved = tighten | loosen
+    return AdaptState(
+        rung=rung,
+        err_sum=jnp.zeros((), jnp.float32),
+        err_peak=jnp.zeros((), jnp.float32),
+        fb_steps=jnp.zeros((), jnp.int32),
+        quiet=quiet, hold=hold,
+        tightens=a.tightens + tighten.astype(jnp.int32),
+        loosens=a.loosens + loosen.astype(jnp.int32),
+        escalations=a.escalations + guard_evidence.astype(jnp.int32),
+        last_change_step=jnp.where(moved, jnp.asarray(count, jnp.int32),
+                                   a.last_change_step))
+
+
+# ---------------------------------------------------------------------------
+# host-side reporting
+# ---------------------------------------------------------------------------
+
+def adapt_report(state: Any) -> dict:
+    """Host-side summary of the adaptive controller in any state pytree:
+    the first armed :class:`AdaptState`'s counters in one device-to-host
+    transfer (the ``audit_report`` twin). Empty dict when no adapt-armed
+    GraceState is present."""
+    from grace_tpu.transform import GraceState
+
+    found: list = []
+
+    def walk(node):
+        if isinstance(node, GraceState) and node.adapt is not None:
+            found.append(node.adapt)
+        return node
+
+    jax.tree_util.tree_map(walk, state,
+                           is_leaf=lambda n: isinstance(n, GraceState))
+    if not found:
+        return {}
+    a = found[0]
+    vals = jax.device_get([a.rung, a.tightens, a.loosens, a.escalations,
+                           a.hold, a.quiet, a.last_change_step])
+    rung, ti, lo, es, hold, quiet, last = (
+        int(np.asarray(v).reshape(-1)[0]) for v in vals)
+    return {"rung": rung, "tightens": ti, "loosens": lo,
+            "escalations": es, "hold": hold, "quiet": quiet,
+            "last_change_step": last}
+
+
+class AdaptMonitor:
+    """Streaming consumer of flushed telemetry rows; emits ``adapt_tighten``
+    / ``adapt_loosen`` sink records on rung transitions.
+
+    The in-graph controller leaves its trail in the telemetry ring's
+    ``adapt_rung`` column (the effective rung each row's wire bytes were
+    priced at); this monitor diffs consecutive rows and writes one flat
+    event per transition into the same sink funnel as the guard/consensus
+    events — which is what lets ``chaos_smoke --adapt`` prove the
+    timeline ordering (adapt_tighten strictly precedes the first guard
+    event) from the artifact alone. Rows inside a guard fallback window
+    (``fallback`` truthy) are skipped: the escape routing forces the
+    effective rung to 0 there, which is the guard's move, not a policy
+    transition.
+    """
+
+    def __init__(self, sink=None):
+        self.sink = sink
+        self.events: list = []
+        self._last_rung: Optional[int] = None
+
+    def observe(self, records) -> list:
+        out: list = []
+        for rec in records:
+            if not isinstance(rec, dict) or rec.get("event") is not None:
+                continue
+            rung = rec.get("adapt_rung")
+            if rung is None or float(rung) < 0:
+                continue
+            if rec.get("fallback"):
+                continue
+            rung = int(rung)
+            if self._last_rung is not None and rung != self._last_rung:
+                kind = ("adapt_tighten" if rung < self._last_rung
+                        else "adapt_loosen")
+                ev = {"event": kind, "step": rec.get("step"),
+                      "rung": rung, "from_rung": self._last_rung}
+                out.append(ev)
+                self.events.append(ev)
+                if self.sink is not None:
+                    self.sink.write(ev)
+            self._last_rung = rung
+        return out

@@ -171,7 +171,9 @@ def replicated_view(tree):
     """``tree`` with the per-rank GraceState payloads (mem/comp/telem/
     watch) dropped: exactly the leaves that must be bit-identical across
     ranks — params, downstream optimizer state, guard counters, and the
-    replicated GraceState scalars (count, rng_key, fallback, audit). The
+    replicated GraceState scalars (count, rng_key, fallback, audit, and
+    the graft-adapt policy state — a diverged rung would desync the
+    ladder dispatch, so it is inside the fingerprint's jurisdiction). The
     graft-watch ring is per-rank by design (its skew columns differ per
     rank by construction), so fingerprinting it would read healthy skew as
     divergence."""
@@ -385,7 +387,14 @@ def _repair(tree, ref, diverged_me, config: ConsensusConfig,
                 fallback=masked_broadcast(node.fallback, ref, axis_name),
                 audit=jax.tree_util.tree_map(
                     lambda a: masked_broadcast(a, ref, axis_name),
-                    node.audit))
+                    node.audit),
+                # graft-adapt policy state is replicated by contract —
+                # a divergent rung would desync the ladder's lax.switch
+                # at the next step, so the repair restores it bit-exactly
+                # alongside the other replicated scalars.
+                adapt=jax.tree_util.tree_map(
+                    lambda a: masked_broadcast(a, ref, axis_name),
+                    node.adapt))
         return masked_broadcast(node, ref, axis_name)
 
     return jax.tree_util.tree_map(fix, tree, is_leaf=_is_grace)

@@ -82,6 +82,20 @@ def _is_grace(x) -> bool:
     return isinstance(x, GraceState)
 
 
+def _reinit_adapt(carried_tree, fresh_tree):
+    """Swap the carried graft-adapt policy state for the fresh init's —
+    the one replicated GraceState field a world resize deliberately does
+    NOT carry (see :func:`reshard_grace_state`)."""
+
+    def graft(carried, fresh):
+        if _is_grace(carried):
+            return carried._replace(adapt=fresh.adapt)
+        return carried
+
+    return jax.tree_util.tree_map(graft, carried_tree, fresh_tree,
+                                  is_leaf=_is_grace)
+
+
 def _grace_world(tree) -> Optional[int]:
     """Leading world-axis extent of the first per-rank GraceState leaf in
     ``tree`` (global layout), or None when no sized per-rank leaf exists."""
@@ -191,6 +205,14 @@ def reshard_grace_state(state, optimizer, old_mesh, new_mesh,
     # residual set at old W is never fetched just to be discarded.
     old_light = jax.device_get(replicated_view(state.opt_state))
     new_opt = carry_replicated(old_light, fresh_opt, convert=put)
+    # graft-adapt policy state is replicated, so carry_replicated grafted
+    # the OLD controller across — but its windowed signal statistics and
+    # operating rung were learned at the old world's error profile (a
+    # W-rank mean/peak is not a W'-rank mean/peak), so the resize
+    # re-initializes it from the NEW transform's init: the ladder
+    # restarts at its configured start rung, robustness-first, exactly
+    # like the re-zeroed residuals.
+    new_opt = _reinit_adapt(new_opt, fresh_opt)
     fields: Dict[str, Any] = {"params": params, "opt_state": new_opt}
     if hasattr(state, "model_state"):
         fields["model_state"] = jax.tree_util.tree_map(

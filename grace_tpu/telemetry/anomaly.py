@@ -153,9 +153,12 @@ class WatchMonitor:
         self.anomalies: List[dict] = []
         self._ewma: Dict[str, Ewma] = {}
         self._active: set = set()          # (kind, metric, rank) hysteresis
-        self._wire_expected: Dict[bool, float] = {}
+        # Expected exchange bytes per (fallback, adapt_rung) phase: the
+        # fallback flip and graft-adapt's rung transitions both change
+        # the honest wire bill, so each phase carries its own baseline.
+        self._wire_expected: Dict[tuple, float] = {}
         if expected_wire is not None:
-            self._wire_expected[False] = float(expected_wire)
+            self._wire_expected[(False, -1)] = float(expected_wire)
 
     # -- plumbing -----------------------------------------------------------
     def _emit(self, step, kind: str, metric: str, rank: int, value: float,
@@ -252,11 +255,18 @@ class WatchMonitor:
             return []
         exchange = (float(wire) - float(rec.get("audit_bytes", 0.0))
                     - float(rec.get("watch_bytes", 0.0))
-                    - float(rec.get("negotiation_bytes", 0.0)))
+                    - float(rec.get("negotiation_bytes", 0.0))
+                    - float(rec.get("adapt_bytes", 0.0)))
         fallback = bool(rec.get("fallback"))
-        expected = self._wire_expected.get(fallback)
+        # graft-adapt makes the exchange bytes legitimately
+        # state-dependent: the expectation is keyed per (fallback, rung)
+        # phase — a rung transition opens a new phase instead of reading
+        # as drift (the per-rung twin of the fallback-phase split).
+        rung = int(rec.get("adapt_rung", -1))
+        phase = (fallback, rung)
+        expected = self._wire_expected.get(phase)
         if expected is None:
-            self._wire_expected[fallback] = exchange
+            self._wire_expected[phase] = exchange
             return []
         drift = abs(exchange - expected)
         score = drift / max(cfg.wire_rtol * max(expected, 1.0), 1e-12)

@@ -29,7 +29,7 @@ __all__ = ["trace_stage", "match_stage", "ALL_STAGES",
            "STAGE_EXCHANGE", "STAGE_DECOMPRESS", "STAGE_MEMORY_UPDATE",
            "STAGE_FWD_BWD", "STAGE_OPTIMIZER", "STAGE_APPLY",
            "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS",
-           "STAGE_RING_HOP", "STAGE_WATCH", "STAGE_BUCKET"]
+           "STAGE_RING_HOP", "STAGE_WATCH", "STAGE_BUCKET", "STAGE_ADAPT"]
 
 # Canonical stage names — one vocabulary for the profiler, the report tool,
 # and the docs. Keep in sync with README "Observability".
@@ -61,6 +61,12 @@ STAGE_WATCH = "grace/watch"
 # The inner pipeline scopes nest inside it; match_stage's rightmost rule
 # still attributes their ops to compress/exchange/… as before.
 STAGE_BUCKET = "grace/bucket"
+# graft-adapt in-graph controller (resilience/adapt.py): the per-step
+# scalar signal reductions (pmean/pmax of the local compression error)
+# plus the window-boundary rung decision — one attributable span, so the
+# controller's (tiny) cost never hides inside the telemetry scope, and
+# static findings against the ladder dispatch name this stage.
+STAGE_ADAPT = "grace/adapt"
 
 # The canonical stage vocabulary, longest-prefix-matchable: the profiler,
 # tools/telemetry_report.py, and the static auditor's finding attribution
@@ -72,7 +78,7 @@ ALL_STAGES = tuple(sorted(
     (STAGE_COMPENSATE, STAGE_COMPRESS, STAGE_EXCHANGE, STAGE_DECOMPRESS,
      STAGE_MEMORY_UPDATE, STAGE_FWD_BWD, STAGE_OPTIMIZER, STAGE_APPLY,
      STAGE_TELEMETRY, STAGE_DENSE_ESCAPE, STAGE_CONSENSUS, STAGE_RING_HOP,
-     STAGE_WATCH, STAGE_BUCKET),
+     STAGE_WATCH, STAGE_BUCKET, STAGE_ADAPT),
     key=len, reverse=True))
 
 

@@ -96,6 +96,22 @@ FIELDS = (
                                     # flat full-axis collective); zero
                                     # for every other codec and during
                                     # dense-fallback windows
+    ("adapt_rung", "first"),        # graft-adapt: the EFFECTIVE ladder
+                                    # rung this step's exchange ran at
+                                    # (0 = dense escape; the guard's
+                                    # fallback flag forces 0) — the rung
+                                    # the row's wire_bytes/ici/dcn were
+                                    # priced at, via the per-rung wire
+                                    # plan (the dense-fallback flip
+                                    # generalized). -1 when the adaptive
+                                    # controller is not armed
+    ("adapt_bytes", "first"),       # graft-adapt signal-reduction wire
+                                    # cost this step (one scalar pmean +
+                                    # one scalar pmax per step —
+                                    # resilience/adapt.adapt_signal_
+                                    # bytes): folded into wire_bytes AND
+                                    # the per-link split exactly like
+                                    # watch_bytes; zero when adapt is off
 )
 
 FIELD_INDEX = {name: i for i, (name, _) in enumerate(FIELDS)}

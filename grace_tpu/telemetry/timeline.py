@@ -10,7 +10,7 @@ around step 140?" means hand-joining five record shapes by eye.
 
 :class:`Timeline` is that join. It classifies every record into a **kind**
 (``telemetry`` / ``watch`` / ``anomaly`` / ``guard`` / ``consensus`` /
-``perf`` / ``lint`` / ``elastic`` / ``other``), orders the whole run by ``(step, file
+``perf`` / ``lint`` / ``elastic`` / ``adapt`` / ``other``), orders the whole run by ``(step, file
 position)`` — file position breaks ties so causality within a step is
 preserved exactly as the run emitted it — and exposes a small query API
 (:meth:`between`, :meth:`kinds`, :meth:`at_step`, :meth:`anomalies`) plus
@@ -28,7 +28,7 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional
 __all__ = ["KINDS", "classify", "TimelineEvent", "Timeline"]
 
 KINDS = ("telemetry", "watch", "anomaly", "guard", "consensus", "perf",
-         "lint", "elastic", "other")
+         "lint", "elastic", "adapt", "other")
 
 
 def classify(record: Mapping[str, Any]) -> str:
@@ -58,6 +58,8 @@ def classify(record: Mapping[str, Any]) -> str:
         return "lint"
     if event.startswith("elastic"):
         return "elastic"
+    if event.startswith("adapt"):
+        return "adapt"
     return "other"
 
 
@@ -202,7 +204,7 @@ class Timeline:
             if isinstance(score, (int, float)):
                 max_score[k] = max(max_score.get(k, 0.0), float(score))
         firsts = {}
-        for kind in ("anomaly", "guard", "consensus", "lint"):
+        for kind in ("anomaly", "guard", "consensus", "lint", "adapt"):
             ev = self.first(kind)
             if ev is not None:
                 firsts[f"first_{kind}_step"] = ev.step

@@ -201,6 +201,13 @@ class GraceState(NamedTuple):
     # grace_tpu.telemetry.aggregate.WatchState when grace_transform was
     # built with watch=..., else None (an empty pytree node).
     watch: Any = None
+    # graft-adapt in-graph controller state (replicated, like count/
+    # fallback/audit — every field derives from the replicated step
+    # counter, the replicated fallback flag, and full-axis pmean/pmax
+    # outputs, so all ranks agree bitwise and the lax.switch rung
+    # dispatch can never desync): a resilience.adapt.AdaptState when
+    # grace_transform was built with adapt=..., else None.
+    adapt: Any = None
 
 
 # The GraceState field split every layout-aware consumer agrees on:
@@ -209,9 +216,13 @@ class GraceState(NamedTuple):
 # REPLICATED fields are bit-identical across ranks (P()) and are exactly
 # what an elastic world-resize carries forward unchanged while the varying
 # fields are re-initialized at the new world (see carry_replicated and
-# grace_tpu.resilience.elastic).
+# grace_tpu.resilience.elastic — which deliberately RE-INITIALIZES the
+# replicated `adapt` policy state at the new world: its windowed signal
+# statistics and operating rung were learned at the old world's error
+# profile).
 GRACE_VARYING_FIELDS = ("mem", "comp", "telem", "watch")
-GRACE_REPLICATED_FIELDS = ("count", "rng_key", "fallback", "audit")
+GRACE_REPLICATED_FIELDS = ("count", "rng_key", "fallback", "audit",
+                           "adapt")
 
 
 def _is_grace(x) -> bool:
@@ -286,7 +297,8 @@ def partition_specs(tree, axis_name):
                                                 node.fallback),
                 telem=jax.tree_util.tree_map(lambda _: vspec, node.telem),
                 audit=jax.tree_util.tree_map(lambda _: P(), node.audit),
-                watch=jax.tree_util.tree_map(lambda _: vspec, node.watch))
+                watch=jax.tree_util.tree_map(lambda _: vspec, node.watch),
+                adapt=jax.tree_util.tree_map(lambda _: P(), node.adapt))
         return jax.tree_util.tree_map(lambda _: P(), node)
 
     return jax.tree_util.tree_map(per_node, tree, is_leaf=_is_grace)
@@ -541,7 +553,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     topology: Optional[Topology] = None,
                     watch=None,
                     mesh=None,
-                    routes: Optional[Sequence] = None
+                    routes: Optional[Sequence] = None,
+                    adapt=None
                     ) -> optax.GradientTransformation:
     """Build the compressed-exchange transformation.
 
@@ -689,9 +702,43 @@ def grace_transform(compressor: Compressor, memory: Memory,
     ``wire_bytes_dcn`` and surfaced as ``watch_bytes``. Requires
     ``telemetry=...`` — the health scalars are the telemetry row's, and
     without a ring there is nowhere to account the gather's wire cost.
+
+    ``adapt`` (None | True | int ``window`` | dict |
+    :class:`grace_tpu.resilience.adapt.AdaptConfig`): arm the in-graph
+    adaptive compression controller (graft-adapt). The declared
+    **degradation ladder** replaces the single static codec: rung 0 is
+    the dense escape (requires ``escape=...`` — rung 0 IS the escape
+    path), rungs 1..R-1 the config's ladder codecs (safest first), and
+    the transform's base ``compressor`` is always the top rung — the
+    steady state a quiet run converges to. Every update executes exactly
+    one rung via ``lax.switch`` on the replicated rung index (the
+    guard's fallback flag forces rung 0, so the M-step dense window is
+    the same branch), and every ``window`` steps the controller moves
+    the rung from the replicated windowed compression-error signal (one
+    scalar pmean + pmax per step — see
+    :mod:`grace_tpu.resilience.adapt` for the tighten/loosen/
+    escalate-and-hold semantics). Requires ``telemetry=...`` with
+    ``compression_error=True`` (the signal IS the telemetry row's
+    relative compression error, computed against the active rung's
+    codec) and ``routes=None`` (the ladder swaps the base codec
+    wholesale; per-leaf route sub-triads are outside the rung plan).
+    Telemetry prices each row at the ACTIVE rung via a per-rung wire
+    plan — the dense-fallback byte flip generalized to R rungs — and
+    surfaces the rung as ``adapt_rung`` plus the signal reductions' cost
+    as ``adapt_bytes``. Policy state (``GraceState.adapt``) is
+    replicated: fingerprinted by the consensus audit, repaired by the
+    masked broadcast, rolled back bitwise by the guard, re-initialized
+    by an elastic world resize.
     """
     telemetry = _normalize_telemetry(telemetry)
     watch = normalize_watch(watch)
+    if adapt is not None and adapt is not False:
+        # Lazy import: resilience.__init__ imports guard, which imports
+        # this module — a module-level import here would cycle.
+        from grace_tpu.resilience.adapt import normalize_adapt
+        adapt = normalize_adapt(adapt, compressor)
+    else:
+        adapt = None
     mesh = MeshSpec.normalize(mesh if mesh is not None
                               else communicator.axis_name)
     if mesh.dp_axis != communicator.axis_name:
@@ -715,6 +762,27 @@ def grace_transform(compressor: Compressor, memory: Memory,
             "gather cost into the ring's wire_bytes — arm "
             "grace_transform(telemetry=True) (or a capacity/config) "
             "alongside watch.")
+    if adapt is not None:
+        if escape is None:
+            raise ValueError(
+                "adapt=... requires escape=...: the degradation ladder's "
+                "rung 0 IS the dense escape path (the same codec+psum the "
+                "guard's fallback window routes through) — arm "
+                "grace_transform(escape=FP16Compressor()/NoneCompressor()) "
+                "alongside adapt.")
+        if telemetry is None or not telemetry.compression_error:
+            raise ValueError(
+                "adapt=... requires telemetry=... with "
+                "compression_error=True: the controller's windowed signal "
+                "IS the telemetry row's relative compression error "
+                "(computed against the active rung's codec) — arm "
+                "grace_transform(telemetry=True) alongside adapt.")
+        if routes:
+            raise ValueError(
+                "adapt=... requires routes=None: the ladder swaps the "
+                "base codec wholesale each rung; per-leaf route "
+                "sub-triads are outside the rung plan (route OR adapt, "
+                "not both).")
     consensus_armed = consensus is not None and consensus is not False
     if escape is not None and not (getattr(escape, "summable_payload", False)
                                    and escape.average):
@@ -781,7 +849,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 telem=(telemetry_init(telemetry)
                        if telemetry is not None else None),
                 audit=audit_init() if consensus_armed else None,
-                watch=(watch_init(watch) if watch is not None else None))
+                watch=(watch_init(watch) if watch is not None else None),
+                adapt=None)
         if grouped:
             stacks = [jnp.stack([leaves[i] for i in idxs])
                       for idxs in _group_views(leaves)]
@@ -798,6 +867,10 @@ def grace_transform(compressor: Compressor, memory: Memory,
             comp = tuple(compressor.init_state(p) for p in leaves)
         # Raw key data (uint32) instead of a typed key array so the whole
         # state is plain-array checkpointable with any writer.
+        adapt_state = None
+        if adapt is not None:
+            from grace_tpu.resilience.adapt import adapt_init
+            adapt_state = adapt_init(adapt)
         return GraceState(count=jnp.zeros((), jnp.int32),
                           rng_key=jax.random.key_data(jax.random.key(seed)),
                           mem=mem, comp=comp,
@@ -806,9 +879,17 @@ def grace_transform(compressor: Compressor, memory: Memory,
                                  if telemetry is not None else None),
                           audit=audit_init() if consensus_armed else None,
                           watch=(watch_init(watch)
-                                 if watch is not None else None))
+                                 if watch is not None else None),
+                          adapt=adapt_state)
 
-    def _run_compressed(operand):
+    def _run_compressed(operand, codec: Optional[Compressor] = None):
+        # ``codec`` overrides the base compressor for one call — the
+        # graft-adapt ladder dispatch runs this same executor once per
+        # rung branch with the rung's codec; everything else (memory,
+        # communicator, fusion plan, rng derivation) is rung-invariant,
+        # which is what keeps the lax.switch branches structurally
+        # interchangeable.
+        compressor_ = codec if codec is not None else compressor
         leaves, mem, comp, step_key = operand
         new_mem, new_comp = [], []
         if grouped:
@@ -845,7 +926,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     jax.random.fold_in(step_key, gi), len(idxs))
 
                 def one(g, ms, cs, key):
-                    return communicator.step(g, ms, cs, memory, compressor,
+                    return communicator.step(g, ms, cs, memory, compressor_,
                                              key)
 
                 out, ms, cs = jax.vmap(one)(stacked, mem[gi],
@@ -889,7 +970,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     flat = jnp.concatenate([jnp.ravel(leaves[i]).astype(
                         cdtype) for i in idxs])
                     out, ms, cs = communicator.step(
-                        flat, mem[b], comp[b], memory, compressor, rng)
+                        flat, mem[b], comp[b], memory, compressor_, rng)
                     off = 0
                     for i in idxs:
                         shape = jnp.shape(leaves[i])
@@ -907,7 +988,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
             for i, (g, ms, cs) in enumerate(zip(leaves, mem, comp,
                                                 strict=True)):
                 comp_i, mem_i, cm_i = (triads[i] if triads is not None
-                                       else _base_triad)
+                                       else (compressor_, memory,
+                                             communicator))
                 rng = jax.random.fold_in(step_key, i)
                 out, ms, cs = cm_i.step(g, ms, cs, mem_i, comp_i, rng)
                 outs.append(out)
@@ -986,7 +1068,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
         except NameError:       # unbound axis name
             return 1
 
-    def _wire_plan(leaves, world):
+    def _wire_plan(leaves, world, codec: Optional[Compressor] = None):
         """(dense, link, escape_link, negotiation) logical bytes for these
         leaves under the active fusion mode at world size ``world``.
         ``negotiation`` is the shared-scale negotiation collectives' cost
@@ -1010,20 +1092,21 @@ def grace_transform(compressor: Compressor, memory: Memory,
         :func:`grace_tpu.utils.metrics.wire_report`."""
         from grace_tpu.utils.metrics import payload_nbytes
 
+        compressor_ = codec if codec is not None else compressor
         if routes:
             # Per-leaf routed pricing; uncached (the plan depends on leaf
             # paths, not just shapes — and this is trace-time-only cost).
             return _routed_wire_plan(leaves, world)
         sig = tuple((tuple(jnp.shape(l)), str(jnp.result_type(l)))
                     for l in leaves)
-        plan = _wire_plan_cache.get((sig, world))
+        plan = _wire_plan_cache.get((sig, world, compressor_))
         if plan is not None:
             return plan
         structs = [jax.ShapeDtypeStruct(shape, jnp.dtype(d))
                    for shape, d in sig]
         dense, comp_b, n_elems = fusion_payload_nbytes(
-            compressor, structs, fusion)
-        vote = bool(getattr(compressor, "vote_aggregate", False))
+            compressor_, structs, fusion)
+        vote = bool(getattr(compressor_, "vote_aggregate", False))
         topo = resolved_topology
         if isinstance(fusion, int) and not isinstance(fusion, bool):
             # The bucketed executor issues one collective CHAIN per bucket,
@@ -1039,7 +1122,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
             for s, count in fusion_payload_structs(structs, fusion):
                 b_elems = int(np.prod(s.shape, dtype=np.int64))
                 lb = communicator.recv_link_bytes(
-                    payload_nbytes(compressor, s), b_elems, world,
+                    payload_nbytes(compressor_, s), b_elems, world,
                     topology=topo, vote=vote)
                 ici += count * lb.ici
                 dcn += count * lb.dcn
@@ -1062,10 +1145,10 @@ def grace_transform(compressor: Compressor, memory: Memory,
         # issues (per bucket/leaf/group) — zero for codecs without one,
         # leaf-size-aware for index negotiations (cyclic Top-K).
         neg_b = sum(count * negotiation_bytes_for(
-            compressor, int(np.prod(s.shape, dtype=np.int64)), world)
+            compressor_, int(np.prod(s.shape, dtype=np.int64)), world)
             for s, count in fusion_payload_structs(structs, fusion))
-        plan = _wire_plan_cache[(sig, world)] = (dense, link, esc_link,
-                                                 neg_b)
+        plan = _wire_plan_cache[(sig, world, compressor_)] = (
+            dense, link, esc_link, neg_b)
         return plan
 
     def _sqsum(ls) -> jax.Array:
@@ -1075,11 +1158,14 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 tot = tot + jnp.sum(jnp.square(l.astype(jnp.float32)))
         return tot
 
-    def _codec_error_sq(leaves, comp, step_key) -> jax.Array:
+    def _codec_error_sq(leaves, comp, step_key,
+                        codec: Optional[Compressor] = None) -> jax.Array:
         """Σ‖x − decompress(compress(x))‖² over the exact structures (and
         rng derivation) the active fusion mode compresses — so with no
         error-feedback memory the duplicate compress CSEs against the
-        pipeline's own."""
+        pipeline's own. ``codec`` overrides the base compressor (the
+        graft-adapt ladder measures the ACTIVE rung's error)."""
+        compressor_ = codec if codec is not None else compressor
         diff = jnp.zeros((), jnp.float32)
         if grouped:
             for gi, idxs in enumerate(_group_views(leaves)):
@@ -1088,8 +1174,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     jax.random.fold_in(step_key, gi), len(idxs))
 
                 def roundtrip(g, cs, key):
-                    payload, ctx, _ = compressor.compress(g, cs, key)
-                    return compressor.decompress(payload, ctx)
+                    payload, ctx, _ = compressor_.compress(g, cs, key)
+                    return compressor_.decompress(payload, ctx)
 
                 dec = jax.vmap(roundtrip)(stacked, comp[gi], keys)
                 diff = diff + _sqsum([stacked - dec])
@@ -1098,27 +1184,38 @@ def grace_transform(compressor: Compressor, memory: Memory,
             for b, idxs in enumerate(buckets):
                 flat = jnp.concatenate([jnp.ravel(leaves[i]).astype(cdtype)
                                         for i in idxs])
-                payload, ctx, _ = compressor.compress(
+                payload, ctx, _ = compressor_.compress(
                     flat, comp[b], jax.random.fold_in(step_key, b))
                 diff = diff + _sqsum([flat
-                                      - compressor.decompress(payload, ctx)])
+                                      - compressor_.decompress(payload,
+                                                               ctx)])
         else:
             triads = _route_plan[0] if routes else None
             for i, g in enumerate(leaves):
                 comp_i = (triads[i][0] if triads is not None
-                          else compressor)
+                          else compressor_)
                 payload, ctx, _ = comp_i.compress(
                     g, comp[i], jax.random.fold_in(step_key, i))
                 diff = diff + _sqsum([g - comp_i.decompress(payload, ctx)])
         return diff
 
-    def _telemetry_next(state: GraceState, leaves, outs, new_mem, step_key):
+    def _telemetry_next(state: GraceState, leaves, outs, new_mem, step_key,
+                        err_value=None, eff_idx=None):
         """One telemetry row, written at slot count % capacity, plus the
         maybe-updated graft-watch summary ring. The row itself is pure
         in-graph math over values the step already computed (plus the
         optional codec round-trip) — no collectives, no host syncs; the
         watch summary (when armed) adds exactly one tiny all_gather on
-        window-boundary steps, whose wire cost is folded into this row."""
+        window-boundary steps, whose wire cost is folded into this row.
+
+        With graft-adapt armed, ``eff_idx`` is the replicated EFFECTIVE
+        rung this step's exchange ran at and ``err_value`` the active
+        rung's relative compression error (already 0 on the dense rung):
+        the row's effective wire bytes then come from a per-rung wire
+        plan indexed by ``eff_idx`` — the dense-fallback byte flip
+        generalized to R rungs, ici/dcn split included — and the rung
+        plus the signal reductions' cost are surfaced as
+        ``adapt_rung``/``adapt_bytes``."""
         if state.telem is None:
             raise ValueError(
                 "grace_transform was built with telemetry=... but the state "
@@ -1140,16 +1237,61 @@ def grace_transform(compressor: Compressor, memory: Memory,
             [jnp.max(jnp.abs(l.astype(jnp.float32))) for l in mem_leaves]))
             if mem_leaves else jnp.zeros((), jnp.float32))
         if telemetry.compression_error:
-            err = jnp.sqrt(_codec_error_sq(leaves, state.comp, step_key)) \
-                / jnp.maximum(grad_norm, jnp.asarray(1e-20, jnp.float32))
-            if escape is not None:
-                # During a dense window the codec is bypassed: the
-                # *effective* error of what actually shipped is ~0.
-                err = jnp.where(jnp.asarray(state.fallback, jnp.bool_),
-                                jnp.zeros((), jnp.float32), err)
+            if err_value is not None:
+                # graft-adapt: the active rung's error, computed once in
+                # update() (shared with the controller's signal) — 0 on
+                # the dense rung by construction, which subsumes the
+                # fallback-window zeroing below.
+                err = jnp.asarray(err_value, jnp.float32)
+            else:
+                err = jnp.sqrt(_codec_error_sq(leaves, state.comp,
+                                               step_key)) \
+                    / jnp.maximum(grad_norm,
+                                  jnp.asarray(1e-20, jnp.float32))
+                if escape is not None:
+                    # During a dense window the codec is bypassed: the
+                    # *effective* error of what actually shipped is ~0.
+                    err = jnp.where(jnp.asarray(state.fallback, jnp.bool_),
+                                    jnp.zeros((), jnp.float32), err)
         else:
             err = jnp.zeros((), jnp.float32)
-        if escape is None:
+        if eff_idx is not None:
+            # Per-rung effective wire plan (graft-adapt): static prices
+            # for every reachable rung — rung 0 is the escape psum, rung
+            # r >= 1 the ladder codec's plan through the same
+            # communicator — selected by the replicated effective rung.
+            # The guard's fallback flag forces eff_idx to 0 upstream, so
+            # the dense-fallback flip is the same mechanism.
+            from grace_tpu.resilience.adapt import adapt_signal_bytes
+            world = _bound_axis_size(communicator.axis_name)
+            rung_plans = [_wire_plan(leaves, world, codec=c)
+                          for c in adapt.ladder]
+            rung_tot = jnp.asarray(
+                [float(esc_link.total)]
+                + [float(p[1].total) for p in rung_plans], jnp.float32)
+            rung_ici = jnp.asarray(
+                [float(esc_link.ici)]
+                + [float(p[1].ici) for p in rung_plans], jnp.float32)
+            rung_dcn = jnp.asarray(
+                [float(esc_link.dcn)]
+                + [float(p[1].dcn) for p in rung_plans], jnp.float32)
+            rung_neg = jnp.asarray(
+                [0.0] + [float(p[3]) for p in rung_plans], jnp.float32)
+            eff = rung_tot[eff_idx]
+            eff_ici = rung_ici[eff_idx]
+            eff_dcn = rung_dcn[eff_idx]
+            ngb = rung_neg[eff_idx]
+            # The signal reductions run every step — two scalar
+            # full-axis collectives, folded like watch_bytes (flat
+            # schedule: ICI within one slice, DCN beyond).
+            ab = jnp.asarray(float(adapt_signal_bytes(world)), jnp.float32)
+            topo = resolved_topology
+            eff = eff + ngb + ab
+            if topo.crosses_dcn(world):
+                eff_dcn = eff_dcn + ngb + ab
+            else:
+                eff_ici = eff_ici + ngb + ab
+        elif escape is None:
             eff = jnp.asarray(float(comp_b), jnp.float32)
             eff_ici = jnp.asarray(float(link.ici), jnp.float32)
             eff_dcn = jnp.asarray(float(link.dcn), jnp.float32)
@@ -1165,22 +1307,25 @@ def grace_transform(compressor: Compressor, memory: Memory,
             eff_dcn = jnp.where(
                 fb, jnp.asarray(float(esc_link.dcn), jnp.float32),
                 jnp.asarray(float(link.dcn), jnp.float32))
-        # Shared-scale negotiation cost, folded like watch_bytes — into
-        # the scalar AND the per-link split (the pmax is a flat full-axis
-        # collective), zeroed during dense-fallback windows (the dense
-        # branch never negotiates).
-        ngb = jnp.asarray(float(neg_b), jnp.float32)
-        if escape is not None:
-            ngb = jnp.where(jnp.asarray(state.fallback, jnp.bool_),
-                            jnp.zeros((), jnp.float32), ngb)
-        if neg_b:
-            world = _bound_axis_size(communicator.axis_name)
-            topo = resolved_topology
-            eff = eff + ngb
-            if topo.crosses_dcn(world):
-                eff_dcn = eff_dcn + ngb
-            else:
-                eff_ici = eff_ici + ngb
+        if eff_idx is None:
+            # Shared-scale negotiation cost, folded like watch_bytes —
+            # into the scalar AND the per-link split (the pmax is a flat
+            # full-axis collective), zeroed during dense-fallback windows
+            # (the dense branch never negotiates). The adapt path above
+            # already selected a per-rung negotiation price instead.
+            ab = jnp.zeros((), jnp.float32)
+            ngb = jnp.asarray(float(neg_b), jnp.float32)
+            if escape is not None:
+                ngb = jnp.where(jnp.asarray(state.fallback, jnp.bool_),
+                                jnp.zeros((), jnp.float32), ngb)
+            if neg_b:
+                world = _bound_axis_size(communicator.axis_name)
+                topo = resolved_topology
+                eff = eff + ngb
+                if topo.crosses_dcn(world):
+                    eff_dcn = eff_dcn + ngb
+                else:
+                    eff_ici = eff_ici + ngb
         new_watch = state.watch
         wb = jnp.zeros((), jnp.float32)
         if watch is not None:
@@ -1233,6 +1378,14 @@ def grace_transform(compressor: Compressor, memory: Memory,
             "wire_bytes_dcn": eff_dcn,
             "watch_bytes": wb,
             "negotiation_bytes": ngb,
+            # graft-adapt: the effective rung this row's bytes were
+            # priced at (-1 = controller not armed) and the signal
+            # reductions' cost (folded into wire_bytes AND the split,
+            # like watch_bytes).
+            "adapt_rung": (eff_idx.astype(jnp.float32)
+                           if eff_idx is not None
+                           else jnp.asarray(-1.0, jnp.float32)),
+            "adapt_bytes": ab,
         })
 
     def update(updates, state: GraceState, params=None):
@@ -1243,7 +1396,69 @@ def grace_transform(compressor: Compressor, memory: Memory,
         base_key = jax.random.wrap_key_data(state.rng_key)
         step_key = jax.random.fold_in(base_key, state.count)
         operand = (tuple(leaves), state.mem, state.comp, step_key)
-        if escape is None:
+        eff_idx = local_err = None
+        adapt_state = state.adapt
+        if adapt is not None:
+            # graft-adapt ladder dispatch: one lax.switch over every
+            # reachable rung — branch 0 is the dense escape (the guard's
+            # fallback flag forces it, so the M-step dense window is this
+            # same branch), branch r the ladder's rung-r codec through
+            # the unchanged memory/communicator/fusion plan. The index is
+            # replicated by construction (the commanded rung is policy
+            # state derived from full-axis reductions; the fallback flag
+            # is the guard's replicated verdict), which is the exact
+            # predicate contract lint pass 1 verifies — every rank takes
+            # the same branch and the rung's collectives rendezvous.
+            if state.adapt is None:
+                raise ValueError(
+                    "grace_transform was built with adapt=... but the "
+                    "state has no AdaptState — it was initialized by a "
+                    "transform without adapt (or restored from such a "
+                    "checkpoint). Re-init the optimizer state with the "
+                    "adapt-enabled transform.")
+            from grace_tpu.resilience.adapt import (adapt_advance,
+                                                    adapt_signal)
+            from grace_tpu.telemetry.scopes import STAGE_ADAPT
+            top = len(adapt.ladder)
+            fb = jnp.asarray(state.fallback, jnp.bool_)
+            eff_idx = jnp.where(
+                fb, jnp.zeros((), jnp.int32),
+                jnp.clip(jnp.asarray(state.adapt.rung, jnp.int32), 0,
+                         top)).astype(jnp.int32)
+            branches = [_run_dense] + [
+                (lambda op, c=c: _run_compressed(op, codec=c))
+                for c in adapt.ladder]
+            try:
+                outs, new_mem, new_comp = lax.switch(eff_idx, branches,
+                                                     operand)
+            except TypeError as e:
+                raise ValueError(
+                    "adapt ladder rungs must thread identical mem/comp "
+                    "state structures (the lax.switch branches return one "
+                    "state type) — a rung whose compressor state changes "
+                    "shape per rung (e.g. a PowerSGD rank ladder) cannot "
+                    f"ride one ladder: {e}") from None
+            # The controller's signal + advance: the ACTIVE rung's local
+            # relative compression error (0 on the dense rung — nothing
+            # lossy shipped), reduced to a replicated (mean, worst-rank)
+            # pair with one scalar pmean + pmax, accumulated into the
+            # replicated window statistics, and decided at the window
+            # boundary (the consensus/watch lax.cond idiom).
+            grad_norm = jnp.sqrt(_sqsum(leaves))
+            err_ops = (tuple(leaves), state.comp, step_key)
+            err_branches = [lambda op: jnp.zeros((), jnp.float32)] + [
+                (lambda op, c=c: jnp.sqrt(_codec_error_sq(
+                    op[0], op[1], op[2], codec=c))
+                 / jnp.maximum(grad_norm, jnp.asarray(1e-20, jnp.float32)))
+                for c in adapt.ladder]
+            with trace_stage(STAGE_ADAPT):
+                local_err = lax.switch(eff_idx, err_branches, err_ops)
+                err_mean, err_peak = adapt_signal(local_err,
+                                                  communicator.axis_name)
+                adapt_state = adapt_advance(state.adapt, adapt,
+                                            state.count, state.fallback,
+                                            err_mean, err_peak)
+        elif escape is None:
             outs, new_mem, new_comp = _run_compressed(operand)
         else:
             # Both branches carry collectives; the predicate is replicated
@@ -1257,11 +1472,14 @@ def grace_transform(compressor: Compressor, memory: Memory,
         if telemetry is not None:
             with trace_stage(STAGE_TELEMETRY):
                 watch_state, telem = _telemetry_next(state, leaves, outs,
-                                                     new_mem, step_key)
+                                                     new_mem, step_key,
+                                                     err_value=local_err,
+                                                     eff_idx=eff_idx)
         new_state = GraceState(count=state.count + 1, rng_key=state.rng_key,
                                mem=new_mem, comp=new_comp,
                                fallback=state.fallback, telem=telem,
-                               audit=state.audit, watch=watch_state)
+                               audit=state.audit, watch=watch_state,
+                               adapt=adapt_state)
         return jax.tree_util.tree_unflatten(treedef, outs), new_state
 
     # The one resolved topology object both pricing paths close over —

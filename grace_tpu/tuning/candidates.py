@@ -107,6 +107,22 @@ def generated_variants(spec: TuneTopology) -> List[Candidate]:
         Candidate("tune-homoqsgd4-ring",
                   {**homoq, "communicator": "ring", "fusion": "flat"},
                   source="generated"),
+        # Self-tuning adaptive candidate (ISSUE 15): the graft-adapt
+        # degradation ladder (dense escape → homoqsgd8 → homoqsgd4) over
+        # the zero-requant ring. Priced at its STEADY STATE (the top
+        # rung == the static homoqsgd4 ring, so a quiet run matches the
+        # static winner's projected throughput exactly); the funnel's
+        # numeric gate additionally checks EVERY rung's
+        # payload_sum_max_world at the target world, and the per-rung
+        # prices ride the funnel record as rung_prices. Same ladder as
+        # the lint-registered adapt-homoqsgd-ring entry, so everything
+        # the tuner can shortlist here is a statically audited schedule.
+        Candidate("tune-adapt-homoqsgd4-ring",
+                  {**homoq, "communicator": "ring", "fusion": "flat",
+                   "escape": "fp16", "telemetry": 16,
+                   "adapt": {"window": 25,
+                             "ladder": [{"quantum_num": 127}]}},
+                  source="generated"),
         # The FSDP exchange (ISSUE 14): one all_to_all + one all_gather,
         # requant chain ≤ 1 at ANY world — the flat-topology schedule
         # that survives the degradation gate where the hop-requant ring
@@ -263,6 +279,16 @@ def candidate_legal(candidate: Candidate, spec: TuneTopology
         reason = _triad_legal(comp, cm, spec)
         if reason:
             return False, f"route {pat!r}: {reason}", grace
+    # graft-adapt ladders: every reachable rung must itself be a legal
+    # triad with the candidate's communicator — the controller can
+    # dispatch any rung mid-run, so one illegal rung is a runtime
+    # TypeError waiting for the first tighten, mirrored here with the
+    # communicator's own rationale.
+    adapt = getattr(grace, "adapt", None)
+    for ri, comp in enumerate(getattr(adapt, "ladder", ()) or ()):
+        reason = _triad_legal(comp, grace.communicator, spec)
+        if reason:
+            return False, f"adapt rung {ri + 1}: {reason}", grace
     return True, None, grace
 
 

@@ -201,7 +201,25 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
 
     step_s = base_step_s + wire_s(link)
     d_step_s = dense_step_s + wire_s(dense_link)
+    adapt = getattr(grace, "adapt", None)
+    extra: Dict[str, Any] = {}
+    if adapt is not None:
+        # graft-adapt candidates are priced at their STEADY STATE — the
+        # top rung IS the base compressor (normalize_adapt's contract),
+        # so the headline projected_step_ms above is exactly the static
+        # top-rung config's: a quiet adaptive run matches the
+        # hand-picked winner's projected throughput by construction.
+        # The full rung schedule rides along so the funnel record shows
+        # what each degradation level costs — the transparency the
+        # "price adaptive candidates by their rung schedule" contract
+        # asks for.
+        extra = {
+            "steady_state_rung": len(adapt.ladder),
+            "rung_prices": adapt_rung_prices(grace, model_structs, spec,
+                                             base_step_s=base_step_s),
+        }
     return {
+        **extra,
         "payload_bytes": int(rep.wire_bytes),
         "wire_ratio": round(rep.wire_bytes / max(1, dense_b), 6),
         "negotiation_bytes": int(neg_b),
@@ -216,3 +234,52 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
         "predicted_speedup_vs_dense": round(d_step_s / step_s, 4)
         if step_s > 0 else None,
     }
+
+
+def adapt_rung_prices(grace, model_structs, spec: TuneTopology, *,
+                      base_step_s: float = 0.0):
+    """Static per-rung prices of a graft-adapt candidate's whole
+    degradation ladder: rung 0 is the dense escape psum (the same
+    Allreduce pricing the dense bracket uses, at the escape codec's
+    payload width), rung r >= 1 the ladder codec through the candidate's
+    own communicator — each through the identical shared per-link model,
+    so the controller's state-dependent wire bill is an enumerated fact
+    in the funnel record, not a surprise at run time."""
+    from grace_tpu.comm import Allreduce
+    from grace_tpu.utils import wire_report
+
+    ici_bw, dcn_bw, _ = projection_constants()
+    n = n_elements(model_structs)
+    topo = spec.core_topology()
+
+    def wire_s(lb):
+        return lb.ici / ici_bw + lb.dcn / dcn_bw
+
+    out = []
+    esc = getattr(grace, "escape", None)
+    esc_b = (wire_report(esc, model_structs).wire_bytes
+             if esc is not None else dense_bytes(model_structs))
+    link0 = Allreduce(
+        axis_name=grace.communicator.axis_name).recv_link_bytes(
+            esc_b, n, spec.world, topology=topo)
+    out.append({"rung": 0,
+                "codec": (type(esc).__name__ if esc is not None
+                          else "dense"),
+                "payload_bytes": int(esc_b),
+                "ici_bytes": int(link0.ici), "dcn_bytes": int(link0.dcn),
+                "projected_step_ms": round(
+                    (base_step_s + wire_s(link0)) * 1e3, 9)})
+    for ri, comp in enumerate(grace.adapt.ladder, start=1):
+        rep = wire_report(comp, model_structs)
+        vote = bool(getattr(comp, "vote_aggregate", False))
+        link = grace.communicator.recv_link_bytes(
+            rep.wire_bytes, n, spec.world, topology=topo, vote=vote)
+        neg = int(comp.negotiation_nbytes(spec.world))
+        out.append({"rung": ri, "codec": type(comp).__name__,
+                    "payload_bytes": int(rep.wire_bytes),
+                    "negotiation_bytes": neg,
+                    "ici_bytes": int(link.ici),
+                    "dcn_bytes": int(link.dcn),
+                    "projected_step_ms": round(
+                        (base_step_s + wire_s(link)) * 1e3, 9)})
+    return out

@@ -98,22 +98,36 @@ def numeric_verdict(grace, spec: TuneTopology) -> Optional[str]:
     from grace_tpu import comm
     from grace_tpu.analysis import flow
 
-    comp, cm = grace.compressor, grace.communicator
+    cm = grace.communicator
     w = spec.world
-    vote = bool(getattr(comp, "vote_aggregate", False))
-    if vote and isinstance(cm, (comm.Allreduce, comm.SignAllreduce)):
-        vd = getattr(cm, "vote_dtype", "bfloat16")
-        bound = comm.vote_exact_max_world(vd)
-        if w > bound:
-            return (f"±1 vote psum in {vd} is integer-exact only to "
-                    f"W={bound} (vote_exact_max_world); W={w} ties would "
-                    "silently round — the runtime vote guard raises here")
-    summable = bool(getattr(comp, "summable_payload", False))
-    sums_payload = (isinstance(cm, (comm.Allreduce, comm.RingAllreduce,
-                                    comm.ReduceScatterAllreduce,
-                                    comm.HierarchicalAllreduce))
-                    and summable and not vote)
-    if sums_payload:
+    # Every reachable codec: the base compressor alone for static
+    # configs, every graft-adapt ladder rung for adaptive ones — the
+    # controller can dispatch any rung mid-run, so a single unsafe rung
+    # is a reachable silent-wrap state the funnel must reject (the same
+    # enumeration flow pass 6's _shared_scale_findings audits).
+    adapt = getattr(grace, "adapt", None)
+    rungs = list(getattr(adapt, "ladder", ()) or ())
+    comps = [grace.compressor] + [c for c in rungs
+                                  if c != grace.compressor]
+    for ri, comp in enumerate(comps):
+        where = "" if ri == 0 else "adapt rung: "
+        vote = bool(getattr(comp, "vote_aggregate", False))
+        if vote and isinstance(cm, (comm.Allreduce, comm.SignAllreduce)):
+            vd = getattr(cm, "vote_dtype", "bfloat16")
+            bound = comm.vote_exact_max_world(vd)
+            if w > bound:
+                return (f"{where}±1 vote psum in {vd} is integer-exact "
+                        f"only to W={bound} (vote_exact_max_world); "
+                        f"W={w} ties would silently round — the runtime "
+                        "vote guard raises here")
+        summable = bool(getattr(comp, "summable_payload", False))
+        sums_payload = (isinstance(cm, (comm.Allreduce,
+                                        comm.RingAllreduce,
+                                        comm.ReduceScatterAllreduce,
+                                        comm.HierarchicalAllreduce))
+                        and summable and not vote)
+        if not sums_payload:
+            continue
         # Shared-scale integer accumulators: the codec's own
         # payload_sum_max_world (iinfo(accum_dtype).max // max level) —
         # the same single constant the communicators' runtime gate and
@@ -123,8 +137,9 @@ def numeric_verdict(grace, spec: TuneTopology) -> Optional[str]:
         if getattr(comp, "payload_algebra", None) == "shared_scale":
             bound = comp.payload_sum_max_world()
             if bound is not None and w > bound:
-                return (f"shared-scale payload sum of W={w} integer levels "
-                        f"exceeds payload_sum_max_world={bound} "
+                return (f"{where}shared-scale payload sum of W={w} "
+                        f"integer levels exceeds "
+                        f"payload_sum_max_world={bound} "
                         "(iinfo(accum_dtype).max // max level) — level "
                         "sums wrap silently; widen accum_dtype or lower "
                         "quantum_num (the communicators raise the same "
@@ -132,8 +147,8 @@ def numeric_verdict(grace, spec: TuneTopology) -> Optional[str]:
         for dt in _payload_float_dtypes(comp):
             terms = flow.safe_sum_terms(dt)
             if terms is not None and w > terms:
-                return (f"payload-space sum of W={w} {dt} terms exceeds "
-                        f"safe_sum_terms({dt})={terms} "
+                return (f"{where}payload-space sum of W={w} {dt} terms "
+                        f"exceeds safe_sum_terms({dt})={terms} "
                         f"(finfo.max/{int(flow.NUMERIC_UNIT_MAG)} unit "
                         "magnitudes) — silent inf, the flow pass-6 cliff")
     return None

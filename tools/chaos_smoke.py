@@ -62,6 +62,23 @@ window, attributing the exact rank, before any guard/consensus event
 exists. Combine with ``--sdc`` to cross-validate: the consensus repair
 zeroes the SDC rank's residuals, which the watch skew detector also sees.
 
+Adapt scenario (ISSUE 15): ``--adapt`` drills the in-graph adaptive
+compression controller (``grace_tpu.resilience.adapt``) through its three
+claims in one timeline-ordered run. Phase A seeds a single-rank
+compression-error drift (``ChaosCompressor(drift_scale=...)`` on every
+ladder rung's codec — finite, so the guard MUST stay silent) and requires
+the controller to TIGHTEN a rung within one window of the spike, with the
+``adapt_tighten`` event landing in the artifact BEFORE any guard event
+exists. Phase B removes the drift and requires the controller to LOOSEN
+back after ``quiet_windows`` quiet windows (the hysteresis claim). Phase C
+injects NaNs so the guard genuinely trips, and requires the controller to
+register the trip as escalate-and-hold evidence (``escalations > 0``) —
+the ladder-floor-too-loose semantics. Evidence (tighten/loosen counts and
+steps, the tighten-before-guard ordering verdict, the rung trace) lands in
+``--adapt-out`` (ADAPT_LAST.json), rendered by evidence_summary.py;
+``adapt_*`` events stream into the telemetry JSONL (timeline kind
+``adapt``).
+
 Elastic scenario (ISSUE 11): ``--elastic`` runs the full preemption
 lifecycle on the 8-device mesh — drift on one rank (guard-blind, like
 ``--watch``) until graft-watch flags it, the :class:`ElasticController`
@@ -169,6 +186,22 @@ def main(argv=None) -> int:
     ap.add_argument("--watch-window", type=int, default=10,
                     help="steps between in-graph cross-rank health "
                          "summaries (with --watch)")
+    ap.add_argument("--adapt", action="store_true",
+                    help="adaptive-controller scenario (ISSUE 15): "
+                         "phase A single-rank drift -> controller "
+                         "tightens within one window (guard silent, "
+                         "adapt_tighten precedes any guard event); "
+                         "phase B quiet -> controller loosens back; "
+                         "phase C NaN injection -> guard trips and the "
+                         "controller escalates-and-holds")
+    ap.add_argument("--adapt-window", type=int, default=8,
+                    help="controller decision window in steps "
+                         "(with --adapt)")
+    ap.add_argument("--adapt-rank", type=int, default=3,
+                    help="mesh index whose encoder drifts in phase A "
+                         "(with --adapt)")
+    ap.add_argument("--adapt-out", default="ADAPT_LAST.json",
+                    help="evidence JSON path for --adapt ('' disables)")
     ap.add_argument("--elastic", action="store_true",
                     help="run the full elastic lifecycle: drift → watch "
                          "drain signal → kill the flagged rank (its whole "
@@ -227,6 +260,8 @@ def main(argv=None) -> int:
             pass
         relax_cpu_collective_timeouts()
 
+    if args.adapt:
+        return _adapt_main(args)
     if args.elastic:
         return _elastic_main(args)
     if args.fsdp:
@@ -753,6 +788,279 @@ def _fsdp_main(args) -> int:
     print("[chaos_smoke] OK" if ok else "[chaos_smoke] FAIL",
           flush=True)
     return 0 if ok else 1
+
+
+def _adapt_main(args) -> int:
+    """The --adapt lifecycle: drift → tighten (before any guard event) →
+    quiet → loosen → NaN → guard trip + escalate-and-hold. Returns 0 only
+    when every acceptance fact holds (see module docstring)."""
+    import dataclasses
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from grace_tpu import grace_from_params
+    from grace_tpu.parallel import data_parallel_mesh
+    from grace_tpu.resilience import (AdaptMonitor, ChaosCommunicator,
+                                      ChaosCompressor, adapt_report,
+                                      guarded_chain)
+    from grace_tpu.telemetry import JSONLSink, TelemetryReader
+    from grace_tpu.telemetry.timeline import Timeline
+    from grace_tpu.train import init_train_state, make_train_step
+    from grace_tpu.utils.logging import GuardMonitor, run_provenance
+    from grace_tpu.utils.metrics import guard_report
+
+    mesh = data_parallel_mesh()
+    world = mesh.devices.size
+    window = args.adapt_window
+    # Phase split: A (drift — must tighten), B (quiet — must loosen),
+    # C (NaN — guard trips, controller escalates). Each phase spans
+    # enough windows for its claim.
+    steps_a = max(3 * window, args.steps // 3)
+    steps_b = max(4 * window, args.steps // 3)
+    steps_c = max(window + args.fallback_after + args.fallback_steps + 2,
+                  args.steps - steps_a - steps_b)
+
+    # The degradation ladder: dense escape (rung 0) → gentle 8-bit qsgd
+    # (rung 1) → aggressive 4-bit-ish qsgd (rung 2, the steady state).
+    # Thresholds sit between the healthy steady-state error (~0.2-0.3 for
+    # q=15 on this model) and the drifted rank's error (~drift_scale):
+    # quiet runs read below loosen_error, the drifting rank's pmax
+    # crosses tighten_peak within its first window.
+    drift = 0.9
+    grace_params = {
+        "compressor": "qsgd", "quantum_num": 15, "use_pallas": False,
+        "memory": "none", "communicator": "allgather",
+        "escape": "fp16",
+        "telemetry": max(2 * args.telemetry_every, 16),
+        "adapt": {"window": window,
+                  "ladder": [{"quantum_num": 127}],
+                  "tighten_error": 0.5, "tighten_peak": 0.6,
+                  "loosen_error": 0.35, "quiet_windows": 2,
+                  "hold_windows": 2},
+    }
+
+    def build(drift_rank=None, nan_prob=0.0):
+        """(grace, guarded tx) for one phase. The drift injector must
+        wrap EVERY ladder rung's codec (the controller swaps codecs
+        mid-run; a drift that only afflicted the top rung would vanish
+        the moment the controller tightened — voiding the scenario)."""
+        grc = grace_from_params(grace_params)
+        if drift_rank is not None:
+            def wrap(c):
+                return ChaosCompressor(inner=c, drift_scale=drift,
+                                       rank=drift_rank,
+                                       seed=args.seed + 3)
+            grc = dataclasses.replace(
+                grc, compressor=wrap(grc.compressor),
+                adapt=dataclasses.replace(
+                    grc.adapt,
+                    ladder=tuple(wrap(c) for c in grc.adapt.ladder)))
+        if nan_prob:
+            grc = dataclasses.replace(grc, communicator=ChaosCommunicator(
+                inner=grc.communicator, nan_prob=nan_prob, rank=args.rank,
+                seed=args.seed + 1))
+        tx = guarded_chain(grc, optax.sgd(args.lr),
+                           fallback_after=args.fallback_after,
+                           fallback_steps=args.fallback_steps)
+        return grc, tx
+
+    # Small dense MLP (the _fsdp_main scale): three phase recompiles with
+    # a 3-branch ladder each — LeNet-sized compiles would triple that
+    # cost for no extra coverage.
+    feat, hid, classes = 32, 16, 8
+    rng = np.random.default_rng(args.seed)
+    params = {
+        "w1": jnp.asarray(rng.normal(scale=0.3, size=(feat, hid)),
+                          jnp.float32),
+        "b1": jnp.zeros((hid,), jnp.float32),
+        "w2": jnp.asarray(rng.normal(scale=0.3, size=(hid, classes)),
+                          jnp.float32),
+        "b2": jnp.zeros((classes,), jnp.float32),
+    }
+
+    def loss_fn(p, b):
+        x, y = b
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    batch = max(args.batch, world) // world * world
+    images = rng.normal(size=(4 * batch, feat)).astype(np.float32)
+    labels = rng.integers(0, classes, size=(4 * batch,)).astype(np.int32)
+
+    def at(i):
+        lo = (i * batch) % (len(images) - batch + 1)
+        return (jnp.asarray(images[lo:lo + batch]),
+                jnp.asarray(labels[lo:lo + batch]))
+
+    sink = reader = None
+    if not args.telemetry_out:
+        print("[chaos_smoke] --adapt requires --telemetry-out: the "
+              "acceptance artifact IS the adapt_tighten/guard event "
+              "ordering", file=sys.stderr)
+        return 1
+    sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+        data="synthetic", tool="chaos_smoke",
+        argv=" ".join(sys.argv[1:]), steps=args.steps,
+        adapt=True, adapt_window=window, adapt_rank=args.adapt_rank))
+    reader = TelemetryReader(sink, every=args.telemetry_every)
+    adapt_mon = AdaptMonitor(sink=sink)
+    monitor = GuardMonitor(sink=sink)
+
+    total = float("nan")
+    t0 = time.perf_counter()
+
+    def run_phase(state, step_fn, lo, hi):
+        loss = float("nan")
+        for i in range(lo, hi):
+            state, loss = step_fn(state, at(i))
+            monitor.update(i, guard_report(state))
+            adapt_mon.observe(reader.update(i, state))
+        return state, float(loss)
+
+    # ---- phase A: one rank's encoder drifts — tighten, guard silent ----
+    grc_a, tx_a = build(drift_rank=args.adapt_rank)
+    state = init_train_state(params, tx_a, mesh)
+    step_a = make_train_step(loss_fn, tx_a, mesh, donate=False)
+    state, _ = run_phase(state, step_a, 0, steps_a)
+    adapt_mon.observe(reader.flush(state))        # drain the tail window
+    guard_a = guard_report(state)
+    tightens_a = [e for e in adapt_mon.events
+                  if e["event"] == "adapt_tighten"]
+    first_tighten = min((e["step"] for e in tightens_a), default=None)
+    rep_a = adapt_report(state)
+    print(f"[chaos_smoke] adapt phase A (drift rank {args.adapt_rank}): "
+          f"{steps_a} steps | rung {rep_a['rung']} | tightens "
+          f"{rep_a['tightens']} (first event step {first_tighten}) | "
+          f"guard skips {guard_a['notfinite_count']}")
+    if guard_a["notfinite_count"] != 0:
+        print("[chaos_smoke] FAIL: guard tripped during the drift phase "
+              "— the fault is finite and guard-invisible; the smoke "
+              "itself is broken", file=sys.stderr)
+        return 1
+    if not tightens_a:
+        print("[chaos_smoke] FAIL: seeded drift produced no adapt_tighten "
+              "event — the controller is not reacting to the error "
+              "spike", file=sys.stderr)
+        return 1
+    if first_tighten > 2 * window:
+        print(f"[chaos_smoke] FAIL: first tighten at step {first_tighten} "
+              f"— later than one window ({window}) plus the decision "
+              "latency", file=sys.stderr)
+        return 1
+
+    # ---- phase B: drift off — the controller must loosen back ----------
+    grc_b, tx_b = build()
+    step_b = make_train_step(loss_fn, tx_b, mesh, donate=False)
+    state, _ = run_phase(state, step_b, steps_a, steps_a + steps_b)
+    adapt_mon.observe(reader.flush(state))
+    loosens = [e for e in adapt_mon.events if e["event"] == "adapt_loosen"]
+    rep_b = adapt_report(state)
+    print(f"[chaos_smoke] adapt phase B (quiet): {steps_b} steps | rung "
+          f"{rep_b['rung']} | loosens {rep_b['loosens']}")
+    if not loosens:
+        print("[chaos_smoke] FAIL: quiet phase produced no adapt_loosen "
+              "event — the controller never recovers from degradation",
+              file=sys.stderr)
+        return 1
+
+    # ---- phase C: NaN injection — guard trips, controller escalates ----
+    grc_c, tx_c = build(nan_prob=1.0)
+    step_c = make_train_step(loss_fn, tx_c, mesh, donate=False)
+    state, total = run_phase(state, step_c, steps_a + steps_b,
+                             steps_a + steps_b + steps_c)
+    adapt_mon.observe(reader.flush(state))
+    reader.close()
+    dt = time.perf_counter() - t0
+
+    guard_c = guard_report(state)
+    rep_c = adapt_report(state)
+    print(f"[chaos_smoke] adapt phase C (NaN): {steps_c} steps | final "
+          f"loss {total:.4f} | guard skips {guard_c['notfinite_count']} | "
+          f"escalations {rep_c['escalations']} | hold {rep_c['hold']} | "
+          f"{dt:.1f}s total")
+
+    # Ordering is judged from the ARTIFACT, not loop bookkeeping: the
+    # first adapt event must precede the first guard event in the unified
+    # timeline — tighten-before-guard is the scenario's whole claim.
+    # (Step-less guard_only flush records are skipped: they carry
+    # counters, not an event position.)
+    tl = Timeline.from_jsonl(args.telemetry_out)
+    first_adapt = next((e for e in tl.kinds("adapt")
+                        if e.step is not None), None)
+    first_guard = next((e for e in tl.kinds("guard")
+                        if e.step is not None), None)
+    ordering_ok = (first_adapt is not None and first_guard is not None
+                   and first_adapt.step < first_guard.step)
+    print(f"[chaos_smoke] adapt ordering: first adapt event step "
+          f"{first_adapt.step if first_adapt else None} < first guard "
+          f"event step {first_guard.step if first_guard else None} -> "
+          f"{'OK' if ordering_ok else 'VIOLATED'}")
+
+    if args.adapt_out:
+        doc = {
+            "tool": "chaos_smoke",
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "argv": " ".join(sys.argv[1:]),
+            "world": world,
+            "window": window,
+            "ladder": ["fp16 dense escape (rung 0)",
+                       "qsgd quantum_num=127 (rung 1)",
+                       "qsgd quantum_num=15 (rung 2, steady state)"],
+            "phases": {"drift": [0, steps_a],
+                       "quiet": [steps_a, steps_a + steps_b],
+                       "nan": [steps_a + steps_b,
+                               steps_a + steps_b + steps_c]},
+            "tighten": {"count": int(rep_c["tightens"]),
+                        "first_step": first_tighten,
+                        "within_one_window": bool(
+                            first_tighten <= 2 * window)},
+            "loosen": {"count": int(rep_c["loosens"]),
+                       "first_step": min((e["step"] for e in loosens),
+                                         default=None)},
+            "escalations": int(rep_c["escalations"]),
+            "final_rung": int(rep_c["rung"]),
+            "first_adapt_step": (first_adapt.step if first_adapt
+                                 else None),
+            "first_guard_step": (first_guard.step if first_guard
+                                 else None),
+            "ordering_ok": bool(ordering_ok),
+            "guard_skips": int(guard_c["notfinite_count"]),
+            "final_loss": float(total),
+        }
+        tmp = args.adapt_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.adapt_out)
+        print(f"[chaos_smoke] adapt evidence: {args.adapt_out}")
+
+    if not np.isfinite(total):
+        print("[chaos_smoke] FAIL: final loss non-finite — the "
+              "guard+ladder stack did not contain the NaN phase",
+              file=sys.stderr)
+        return 1
+    if guard_c["notfinite_count"] == 0:
+        print("[chaos_smoke] FAIL: guard never tripped in the NaN phase "
+              "— injection is not reaching the pipeline", file=sys.stderr)
+        return 1
+    if rep_c["escalations"] == 0:
+        print("[chaos_smoke] FAIL: the controller registered no "
+              "escalate-and-hold evidence despite the guard's fallback "
+              "windows", file=sys.stderr)
+        return 1
+    if not ordering_ok:
+        print("[chaos_smoke] FAIL: the first adapt event does not "
+              "precede the first guard event — tighten-before-guard is "
+              "the scenario's claim", file=sys.stderr)
+        return 1
+    print("[chaos_smoke] OK")
+    return 0
 
 
 def _elastic_main(args) -> int:

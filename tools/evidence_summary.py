@@ -6,6 +6,8 @@ Reads (all repo-root, all optional — missing files are skipped):
   BENCH_ALL_CPU.json       per-algorithm CPU-mesh smoke sweep
   TPU_VARIANTS.jsonl       selection-variant session rows
   ELASTIC_LAST.json        chaos_smoke --elastic resize/rejoin evidence
+  ADAPT_LAST.json          chaos_smoke --adapt controller evidence
+                           (tighten-before-guard ordering, loosen counts)
 
 Usage: python tools/evidence_summary.py [--update-readme]
 Prints markdown to stdout; --update-readme splices it between the
@@ -353,6 +355,31 @@ def build() -> str:
             "Elastic training (graft-elastic): `chaos_smoke --elastic` → "
             + ", ".join(bits)
             + f" (`ELASTIC_LAST.json`{', ' + when if when else ''}).")
+    adapt = _load("ADAPT_LAST.json")
+    if isinstance(adapt, dict) and adapt.get("tool") == "chaos_smoke":
+        when = (adapt.get("captured_at") or "").split("T")[0]
+        ti = adapt.get("tighten") or {}
+        lo = adapt.get("loosen") or {}
+        within = "within one window" if ti.get("within_one_window") \
+            else "LATE (outside one window)"
+        order = ("adapt_tighten precedes the first guard event"
+                 if adapt.get("ordering_ok")
+                 else "ORDERING VIOLATED (guard fired first)")
+        bits = [
+            f"{len(adapt.get('ladder') or [])}-rung ladder, window "
+            f"{adapt.get('window', '?')} steps",
+            f"drift → {ti.get('count', '?')} tighten(s), first at step "
+            f"{ti.get('first_step', '?')} ({within})",
+            f"quiet → {lo.get('count', '?')} loosen(s)",
+            f"NaN → {adapt.get('guard_skips', '?')} guard skip(s), "
+            f"{adapt.get('escalations', '?')} escalate-and-hold(s)",
+            order,
+        ]
+        parts.append("")
+        parts.append(
+            "Adaptive compression (graft-adapt): `chaos_smoke --adapt` → "
+            + ", ".join(bits)
+            + f" (`ADAPT_LAST.json`{', ' + when if when else ''}).")
     watch = _load("WATCH_LAST.json")
     if isinstance(watch, dict) and watch.get("tool") == "graft_watch":
         when = (watch.get("captured_at") or "").split("T")[0]

@@ -37,7 +37,8 @@ from typing import List, Optional
 PREFERRED = ["grad_norm", "update_norm", "residual_norm", "residual_max",
              "compression_error", "wire_bytes", "wire_bytes_ici",
              "wire_bytes_dcn", "dense_bytes", "fallback", "audit_bytes",
-             "watch_bytes", "negotiation_bytes"]
+             "watch_bytes", "negotiation_bytes", "adapt_rung",
+             "adapt_bytes"]
 
 
 def load(path: str):
@@ -181,8 +182,16 @@ def render(provenance, records, events,
     watch = [e for e in events
              if e.get("event") in ("watch", "watch_anomaly")]
     lint = [e for e in events if e.get("event") == "lint_finding"]
+    adapt = [e for e in events
+             if str(e.get("event", "")).startswith("adapt")]
     other = [e for e in events
-             if e not in perf and e not in watch and e not in lint]
+             if e not in perf and e not in watch and e not in lint
+             and e not in adapt]
+    if adapt or any("adapt_rung" in r and float(r["adapt_rung"]) >= 0
+                    for r in records):
+        out.append("")
+        out.append("== adapt (graft-adapt rung transitions) ==")
+        out.extend(_render_adapt(adapt, records))
     if watch:
         out.append("")
         out.append("== watch (graft-watch summaries + anomalies) ==")
@@ -208,6 +217,39 @@ def render(provenance, records, events,
     if not other:
         out.append("  (none)")
     return "\n".join(out)
+
+
+def _render_adapt(adapt: List[dict], records: List[dict]) -> List[str]:
+    """graft-adapt controller trail: the rung trajectory from the metric
+    rows' ``adapt_rung`` column plus one line per tighten/loosen
+    transition event — rendered before the guard log because tightening
+    ahead of the guard is the controller's whole claim."""
+    out = []
+    rungs = [(r["step"], int(r["adapt_rung"])) for r in records
+             if "adapt_rung" in r and float(r["adapt_rung"]) >= 0
+             and "step" in r]
+    if rungs:
+        lo = min(v for _, v in rungs)
+        hi = max(v for _, v in rungs)
+        out.append(f"  rung range over {len(rungs)} recorded steps: "
+                   f"{lo}..{hi} (0 = dense escape; last "
+                   f"{rungs[-1][1]} at step {rungs[-1][0]})")
+        # Effective-rung dwell: how the state-dependent wire bill splits.
+        counts: dict = {}
+        for _, v in rungs:
+            counts[v] = counts.get(v, 0) + 1
+        dwell = ", ".join(f"rung {k}: {v}" for k, v in sorted(counts.items()))
+        out.append(f"  dwell (steps per effective rung): {dwell}")
+    tightens = [e for e in adapt if e.get("event") == "adapt_tighten"]
+    loosens = [e for e in adapt if e.get("event") == "adapt_loosen"]
+    out.append(f"  transitions: {len(tightens)} tighten(s), "
+               f"{len(loosens)} loosen(s)")
+    for e in adapt:
+        out.append(f"    step {e.get('step', '?'):>6}: {e['event']} "
+                   f"rung {e.get('from_rung', '?')} -> {e.get('rung', '?')}")
+    if not adapt and not rungs:
+        out.append("  (controller armed but no rows recorded)")
+    return out
 
 
 def _render_watch(watch: List[dict]) -> List[str]:
@@ -358,10 +400,14 @@ def build_doc(provenance, records, events,
                         if str(e.get("event", "")).startswith("perf_")],
         "lint_findings": [e for e in events
                           if e.get("event") == "lint_finding"],
+        "adapt_events": [e for e in events
+                         if str(e.get("event", "")).startswith("adapt")],
         "guard_events": [e for e in events
                          if e.get("event") not in ("watch", "watch_anomaly",
                                                    "lint_finding")
-                         and not str(e.get("event", "")).startswith("perf_")],
+                         and not str(e.get("event", "")).startswith("perf_")
+                         and not str(e.get("event", "")).startswith(
+                             "adapt")],
     }
     return doc
 
