@@ -197,12 +197,21 @@ def setup_platform(platform: str):
 # cost: projected_step = measured_single_chip_step + recv_bytes/bandwidth.
 ICI_RING_BYTES_PER_S = 9.0e10
 DCN_BYTES_PER_S = 2.5e10
+# Cross-region (WAN) bandwidth: a documented model assumption, not a
+# measured number — inter-metro links budget ~2 Gb/s of sustained
+# per-host collective bandwidth (~100x below DCN), the regime where
+# compression decides feasibility rather than step time.
+WAN_BYTES_PER_S = 2.5e8
 PROJECTION_WORLDS = (8, 16, 64, 256)
 # Cross-slice scenario topology: slices of 8 chips (the one real v5e slice
 # this repo has measured), DCN between them. Drives the per-link
 # (ici_bytes, dcn_bytes) split in each projection row via the shared
 # Communicator.recv_link_bytes model.
 XSLICE_CHIPS = 8
+# Three-tier scenario: W=1024 ranks as 4 regions x 256 ranks, slices of
+# XSLICE_CHIPS — the cross-region projection row (project_three_tier).
+REGION_WORLD = 1024
+REGION_CHIPS = 256
 
 # Stamped ONCE per evidence document (_write_evidence) and once in the
 # headline JSON line so the numbers carry their own assumptions (VERDICT r4
@@ -211,12 +220,15 @@ XSLICE_CHIPS = 8
 PROJECTION_MODEL = {
     "ici_bytes_per_s": ICI_RING_BYTES_PER_S,
     "dcn_bytes_per_s": DCN_BYTES_PER_S,
+    "wan_bytes_per_s": WAN_BYTES_PER_S,
     "constants_source": (
         "TPU v5e: 4 ICI links/chip in a 2D torus, ~45 GB/s per direction "
         "per link (cloud.google.com/tpu/docs/system-architecture-tpu-vm; "
         "jax-ml.github.io/scaling-book/ 'TPU networking'); a 1-D ring "
         "collective rides 2 links -> ~90 GB/s per chip. DCN ~25 GB/s/host "
-        "(scaling-book cross-slice figure)."),
+        "(scaling-book cross-slice figure). WAN ~0.25 GB/s/host of "
+        "sustained cross-region collective bandwidth — a MODEL ASSUMPTION "
+        "(~100x below DCN), not a measurement."),
     "assumption": (
         "NO-OVERLAP upper bound on wire cost: projected_step = "
         "measured_single_chip_step + recv_bytes/bandwidth. Real XLA "
@@ -240,6 +252,17 @@ PROJECTION_MODEL = {
         "flips the W=256 xslice speedup above 1x dense for topk1pct; "
         "graft-lint's wire_reconciliation pass audits the split "
         "leg-by-leg against the traced collectives."),
+    "three_tier": (
+        f"the region block projects W={REGION_WORLD} as "
+        f"{REGION_WORLD // REGION_CHIPS} regions x {REGION_CHIPS} ranks "
+        f"(slices of {XSLICE_CHIPS}) under Topology(slice_size="
+        f"{XSLICE_CHIPS}, region_size={REGION_CHIPS}), pricing each leg "
+        "at its own bandwidth. A flat two-tier hier comm's whole "
+        "cross-slice leg crosses regions (its groups mix regions), so it "
+        "prices at WAN; the three-level schedule keeps (K/R-1) partials "
+        "on DCN and ships only (R-1) shards across WAN — the gap that "
+        "makes cross-region training feasible at all under the WAN "
+        "constant."),
 }
 
 
@@ -307,6 +330,66 @@ def project_multichip(step_s: float, dense_step_s: float, grace,
         }
         out.append(row)
     return out
+
+
+def project_three_tier(step_s: float, dense_step_s: float, grace,
+                       wire_b: int, dense_b: int, n_elems: int) -> dict:
+    """The W=1024 cross-region projection row: this config's codec at 4
+    regions × 256 ranks (slices of ``XSLICE_CHIPS``), with each leg of the
+    per-link split priced at its own bandwidth — ICI / DCN / WAN.
+
+    Three schedules over the SAME codec payload, all through the one
+    shared ``recv_link_bytes`` model: ``dense`` (flat ring, whole bill at
+    WAN — the critical rank's incoming link crosses regions),
+    ``flat_two_tier_hier`` (slices only: its cross-slice groups mix
+    regions, so the (K−1)·k/S partial-exchange leg ALSO prices at WAN),
+    and ``three_tier_hier`` (the three-level schedule: cross-slice
+    partials stay on DCN inside each region; only (R−1) shards cross
+    WAN). Under the ~100×-below-DCN WAN constant the three-level schedule
+    is what keeps the projected step bounded at all — the row exists to
+    make that gap a quoted number rather than prose."""
+    from grace_tpu.comm import Allreduce, HierarchicalAllreduce
+    from grace_tpu.core import Topology
+
+    w = REGION_WORLD
+    vote = getattr(grace.compressor, "vote_aggregate", False)
+    topo3 = Topology(slice_size=XSLICE_CHIPS, region_size=REGION_CHIPS)
+
+    def t_split(base_s, link):
+        return (base_s + link.ici / ICI_RING_BYTES_PER_S
+                + link.dcn / DCN_BYTES_PER_S + link.wan / WAN_BYTES_PER_S)
+
+    def leg(link):
+        return {"ici_bytes": int(link.ici), "dcn_bytes": int(link.dcn),
+                "wan_bytes": int(link.wan)}
+
+    dense_link = Allreduce().recv_link_bytes(
+        dense_b, n_elems, w, topology=topo3)
+    hier2_link = HierarchicalAllreduce(
+        slice_size=XSLICE_CHIPS).recv_link_bytes(
+            wire_b, n_elems, w, topology=topo3, vote=vote)
+    hier3_link = HierarchicalAllreduce(
+        slice_size=XSLICE_CHIPS, region_size=REGION_CHIPS).recv_link_bytes(
+            wire_b, n_elems, w, topology=topo3, vote=vote)
+
+    t_dense = t_split(dense_step_s, dense_link)
+    t_hier2 = t_split(step_s, hier2_link)
+    t_hier3 = t_split(step_s, hier3_link)
+    return {
+        "world": w,
+        "slice_size": XSLICE_CHIPS,
+        "region_size": REGION_CHIPS,
+        "regions": w // REGION_CHIPS,
+        "dense": {**leg(dense_link),
+                  "step_ms": round(t_dense * 1e3, 3)},
+        "flat_two_tier_hier": {**leg(hier2_link),
+                               "step_ms": round(t_hier2 * 1e3, 3)},
+        "three_tier_hier": {**leg(hier3_link),
+                            "step_ms": round(t_hier3 * 1e3, 3),
+                            "speedup_vs_dense": round(t_dense / t_hier3, 3),
+                            "speedup_vs_flat_hier": round(
+                                t_hier2 / t_hier3, 3)},
+    }
 
 
 def throughput(step, ts, batch, n_batches, warmup=2):
@@ -643,6 +726,9 @@ def bench_configs(platform: str, configs, emit) -> None:
             "projection": project_multichip(
                 global_bs / imgs, global_bs / base_med, ent.grace,
                 wire_b, dense_b, n_elems),
+            "projection_three_tier": project_three_tier(
+                global_bs / imgs, global_bs / base_med, ent.grace,
+                wire_b, dense_b, n_elems),
             "platform": devices[0].platform,
             "n_devices": len(devices),
             "per_device_bs": bs,
@@ -691,6 +777,7 @@ def _worker(platform: str) -> None:
         "mfu": compressed.get("mfu"),
         "mfu_dense": results[0].get("mfu"),
         "projection": compressed.get("projection"),
+        "projection_three_tier": compressed.get("projection_three_tier"),
         "projection_model": PROJECTION_MODEL,
     }), flush=True)
 

@@ -160,6 +160,26 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
     _cfg("homoqsgd-hier", {"compressor": "homoqsgd", "quantum_num": 7,
                            "memory": "residual", "communicator": "hier",
                            "slice_size": 4, "fusion": "flat"}),
+    # -- three-tier WAN family (ISSUE 16): slice_size=2 + region_size=4
+    #    puts BOTH a slice and a region boundary inside the 8-way audit
+    #    mesh (2 regions × 2 slices × 2 ranks), so the traced three-level
+    #    schedule exercises intra-slice ppermute hops (ICI), same-region
+    #    cross-slice gathers (DCN), and cross-region gathers (WAN) — and
+    #    wire_reconciliation reconciles all THREE legs against
+    #    HierarchicalAllreduce.recv_link_bytes under the comm's own
+    #    (slice_size, region_size).
+    _cfg("topk-hier3", {"compressor": "topk", "compress_ratio": 0.25,
+                        "topk_algorithm": "chunk", "memory": "residual",
+                        "communicator": "hier", "slice_size": 2,
+                        "region_size": 4, "fusion": "flat"}),
+    # Homomorphic payloads cross the WAN tier exactly-summable (zero
+    # requant at BOTH the slice and the region boundary) — the traced
+    # schedule is negotiate pmax + int hops + two nested gather-sums +
+    # ONE decode.
+    _cfg("homoqsgd-hier3", {"compressor": "homoqsgd", "quantum_num": 7,
+                            "memory": "residual", "communicator": "hier",
+                            "slice_size": 2, "region_size": 4,
+                            "fusion": "flat"}),
     # Mergeable count-sketch over the gather family: the sketch algebra's
     # ctx (hash indices/signs) is rng-derived, so the data-free-ctx decode
     # contract holds and the payload (rows × width f32 tables) reconciles
@@ -398,6 +418,22 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
          {"compressor": "homoqsgd", "quantum_num": 7, "memory": "residual",
           "communicator": "hier", "slice_size": 4, "fusion": "flat",
           "escape": "fp16", "consensus": True},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+    # The three-level WAN schedule under the full resilience stack
+    # (ISSUE 16): the escape cond's compressed branch now carries THREE
+    # nested levels of grouped sub-axis collectives (intra-slice hops,
+    # same-region cross-slice gather, cross-region gather) plus the
+    # slice- and region-boundary requants, while its dense branch stays
+    # the fp16 psum; the consensus audit fingerprints downstream of the
+    # three-level aggregate — collective_consistency and bit_exactness
+    # must bless every replicated-predicate argument with both extra
+    # boundaries in place.
+    _cfg("hier3-guard-consensus",
+         {"compressor": "topk", "compress_ratio": 0.25,
+          "topk_algorithm": "chunk", "memory": "residual",
+          "communicator": "hier", "slice_size": 2, "region_size": 4,
+          "fusion": "flat", "escape": "fp16", "consensus": True},
          passes=_NO_WIRE, mode="train",
          guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
     # The full observability+resilience stack in one trace: watch's gated

@@ -407,44 +407,57 @@ def _group_size(eqn, world: int) -> int:
     return len(groups[0])
 
 
-def _crosses_slice(eqn, world: int, topology) -> bool:
-    """Whether this collective's schedule touches a DCN boundary link under
-    ``topology`` — the critical-path attribution of
+def _link_tier(eqn, world: int, topology) -> int:
+    """Worst link tier this collective's schedule touches under
+    ``topology`` — 0 = ICI (intra-slice), 1 = DCN (cross-slice), 2 = WAN
+    (cross-region): the critical-path attribution of
     :meth:`~grace_tpu.core.Communicator.recv_link_bytes`, derived from the
     *traced* rank sets instead of the hand-maintained model:
 
-    * a ``ppermute`` crosses iff any (src, dst) pair sits in different
-      slices (a flat ring's wrap-around neighbor pair always does once the
-      axis spans slices — which is why flat rings price all-DCN);
-    * a grouped collective crosses iff any group mixes slices (the
-      hierarchical comm's cross-slice groups do; its intra-slice groups
-      never);
-    * an ungrouped full-axis collective crosses iff the axis itself does.
+    * a ``ppermute`` crosses a boundary iff any (src, dst) pair sits on
+      different sides of it (a flat ring's wrap-around neighbor pair
+      always does once the axis spans the boundary — which is why flat
+      rings price at the worst tier the axis spans);
+    * a grouped collective crosses iff any group mixes sides (the
+      hierarchical comm's cross-slice groups cross DCN yet stay inside a
+      region; its cross-region groups cross WAN; intra-slice groups
+      never cross anything);
+    * an ungrouped full-axis collective crosses whatever the axis does.
     """
     if topology is None or not topology.crosses_dcn(world):
-        return False
-    s = topology.slice_size
-    if eqn.primitive.name in _PERMUTES:
-        perm = eqn.params.get("perm") or ()
-        return any(int(a) // s != int(b) // s for a, b in perm)
-    groups = eqn.params.get("axis_index_groups")
-    if groups:
-        return any(len({int(r) // s for r in grp}) > 1 for grp in groups)
-    return True
+        return 0
+    spans = [topology.slice_size]
+    if topology.region_size is not None and topology.crosses_wan(world):
+        spans.append(topology.region_size)
+
+    def crosses(span: int) -> bool:
+        if eqn.primitive.name in _PERMUTES:
+            perm = eqn.params.get("perm") or ()
+            return any(int(a) // span != int(b) // span for a, b in perm)
+        groups = eqn.params.get("axis_index_groups")
+        if groups:
+            return any(len({int(r) // span for r in grp}) > 1
+                       for grp in groups)
+        return True
+
+    tier = 0
+    for i, span in enumerate(spans, start=1):
+        if crosses(span):
+            tier = i
+    return tier
 
 
 def count_recv_bytes(jaxpr, axis_name: str, world: int) -> int:
     """Logical bytes RECEIVED per rank for the collectives in ``jaxpr`` —
     the scalar view of :func:`count_recv_link_bytes`."""
-    link = count_recv_link_bytes(jaxpr, axis_name, world, None)
-    return link[0] + link[1]
+    return sum(count_recv_link_bytes(jaxpr, axis_name, world, None))
 
 
 def count_recv_link_bytes(jaxpr, axis_name: str, world: int,
-                          topology) -> Tuple[int, int]:
+                          topology) -> Tuple[int, int, int]:
     """Per-rank received bytes of the collectives in ``jaxpr``, split into
-    ``(ici, dcn)`` by whether each collective's traced schedule crosses a
-    slice boundary under ``topology`` (recursive; cond branches count as
+    ``(ici, dcn, wan)`` by the worst boundary each collective's traced
+    schedule crosses under ``topology`` (recursive; cond branches count as
     the branch with the larger total — an upper bound matching how the wire
     model prices the live path). ``topology=None`` attributes everything to
     ICI (the single-slice scalar count).
@@ -457,7 +470,7 @@ def count_recv_link_bytes(jaxpr, axis_name: str, world: int,
     every other member's shard ``n·(G-1)``; a ppermute hop receives one
     full operand; all_to_all and reduce_scatter receive ``n·(G-1)/G``.
     """
-    ici = dcn = 0
+    tiers = [0, 0, 0]
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS and axis_name in _axes_of(eqn):
@@ -472,25 +485,20 @@ def count_recv_link_bytes(jaxpr, axis_name: str, world: int,
                 got = nbytes
             else:                      # all_to_all / reduce_scatter
                 got = nbytes * (g - 1) // max(1, g)
-            if _crosses_slice(eqn, world, topology):
-                dcn += got
-            else:
-                ici += got
+            tiers[_link_tier(eqn, world, topology)] += got
         elif name == "cond":
             branches = [count_recv_link_bytes(getattr(b, "jaxpr", b),
                                               axis_name, world, topology)
                         for b in eqn.params["branches"]]
             if branches:
-                bi, bd = max(branches, key=lambda x: x[0] + x[1])
-                ici += bi
-                dcn += bd
+                best = max(branches, key=sum)
+                tiers = [a + b for a, b in zip(tiers, best)]
         else:
             for sub in _sub_jaxprs_of(eqn):
-                si, sd = count_recv_link_bytes(sub, axis_name, world,
-                                               topology)
-                ici += si
-                dcn += sd
-    return ici, dcn
+                sub_t = count_recv_link_bytes(sub, axis_name, world,
+                                              topology)
+                tiers = [a + b for a, b in zip(tiers, sub_t)]
+    return tiers[0], tiers[1], tiers[2]
 
 
 def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
@@ -550,14 +558,14 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
                 comp_b, n_elems, traced.world, topology=topo, vote=vote)
             if not neg_b:
                 return lb
-            # Negotiations are flat full-axis collectives: ICI within one
-            # slice, DCN the moment the axis crosses — same rule the
-            # telemetry fold uses.
+            # Negotiations are flat full-axis collectives: their bytes
+            # land on the worst tier the axis spans (ICI within one
+            # slice, DCN across slices, WAN across regions) — same
+            # flat_tier rule the telemetry fold uses.
             from grace_tpu.core import Topology as _T
             t = topo if topo is not None else _T()
-            if t.crosses_dcn(traced.world):
-                return LinkBytes(ici=lb.ici, dcn=lb.dcn + neg_b)
-            return LinkBytes(ici=lb.ici + neg_b, dcn=lb.dcn)
+            tier = t.flat_tier(traced.world)
+            return lb._replace(**{tier: getattr(lb, tier) + neg_b})
 
         model = grace.communicator.recv_wire_bytes(
             comp_b, n_elems, traced.world, vote=vote) + neg_b
@@ -579,22 +587,28 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
                      ("counted_bytes", int(counted)),
                      ("world", traced.world)))]
     # Scalar model reconciles — now hold the per-link breakdown to it.
-    # The split (ici, dcn) must sum to the scalar bit-exactly under any
-    # topology: a communicator that overrides recv_link_bytes without
+    # The split (ici, dcn, wan) must sum to the scalar bit-exactly under
+    # any topology: a communicator that overrides recv_link_bytes without
     # keeping the identity (or vice versa) would make bench projections
-    # price different bytes than telemetry records. Checked at both the
-    # single-slice default and a slice boundary that forces the DCN leg.
+    # price different bytes than telemetry records. Checked at the
+    # single-slice default, a slice boundary that forces the DCN leg, and
+    # a region boundary that forces the WAN leg.
     from grace_tpu.core import Topology
-    for topo in (None, Topology(slice_size=max(1, traced.world // 2))):
+    half = max(1, traced.world // 2)
+    identity_topos = [None, Topology(slice_size=half)]
+    if traced.world >= 4:
+        identity_topos.append(Topology(slice_size=max(1, traced.world // 4),
+                                       region_size=half))
+    for topo in identity_topos:
         link = model_link_at(topo)
-        if link.ici + link.dcn != model:
+        if link.total != model:
             return [Finding(
                 pass_name="wire_reconciliation", config=traced.name,
                 severity="error", stage="grace/exchange",
                 message=(
                     f"{comm_name} "
-                    f"splits into ici={link.ici} + dcn={link.dcn} = "
-                    f"{link.ici + link.dcn} B under topology "
+                    f"splits into ici={link.ici} + dcn={link.dcn} + "
+                    f"wan={link.wan} = {link.total} B under topology "
                     f"{topo!r}, but the scalar model says {model} B — the "
                     "per-link breakdown and the scalar model must be one "
                     "implementation (override _recv_total_bytes, not the "
@@ -602,6 +616,7 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
                 details=(("model_bytes", int(model)),
                          ("ici_bytes", int(link.ici)),
                          ("dcn_bytes", int(link.dcn)),
+                         ("wan_bytes", int(link.wan)),
                          ("world", traced.world)))]
     # Finally reconcile the split itself against the TRACED schedule: put a
     # slice boundary on the audit mesh (the communicator's own slice_size
@@ -613,13 +628,17 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
     # moved more than the modeled partials, drifts leg-by-leg even when
     # the scalar total still balances.
     own_slice = getattr(grace.communicator, "slice_size", None)
-    audit_topo = Topology(slice_size=(int(own_slice) if own_slice
-                                      else max(1, traced.world // 2)))
+    own_region = getattr(grace.communicator, "region_size", None)
+    audit_topo = Topology(
+        slice_size=(int(own_slice) if own_slice
+                    else max(1, traced.world // 2)),
+        region_size=int(own_region) if own_region else None)
     counted_link = count_recv_link_bytes(
         traced.body, traced.axis_name, traced.world, audit_topo)
     model_link = model_link_at(audit_topo)
     for leg, got, want in (("ici", counted_link[0], model_link.ici),
-                           ("dcn", counted_link[1], model_link.dcn)):
+                           ("dcn", counted_link[1], model_link.dcn),
+                           ("wan", counted_link[2], model_link.wan)):
         tol = max(WIRE_MODEL_RTOL * max(got, want), WIRE_MODEL_ATOL)
         if abs(got - want) > tol:
             return [Finding(
@@ -630,7 +649,8 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
                     f"models {leg}={want} B under topology {audit_topo!r} "
                     f"but the traced schedule moves {got} B over that link "
                     f"class (counted split ici={counted_link[0]}, "
-                    f"dcn={counted_link[1]}) — drift {abs(got - want)} B "
+                    f"dcn={counted_link[1]}, wan={counted_link[2]}) — "
+                    f"drift {abs(got - want)} B "
                     f"exceeds the documented tolerance "
                     f"(rtol={WIRE_MODEL_RTOL}, atol={WIRE_MODEL_ATOL} B); "
                     "the per-link projections and telemetry split are "
@@ -638,8 +658,10 @@ def pass_wire_reconciliation(traced: TracedGraph) -> List[Finding]:
                 details=(("leg", leg),
                          ("model_ici", int(model_link.ici)),
                          ("model_dcn", int(model_link.dcn)),
+                         ("model_wan", int(model_link.wan)),
                          ("counted_ici", int(counted_link[0])),
                          ("counted_dcn", int(counted_link[1])),
+                         ("counted_wan", int(counted_link[2])),
                          ("world", traced.world)))]
     return []
 

@@ -1027,7 +1027,8 @@ class ReduceScatterAllreduce(Communicator):
 
 @dataclasses.dataclass(frozen=True)
 class HierarchicalAllreduce(Communicator):
-    """Two-level ICI×DCN compressed all-reduce: the cross-slice schedule.
+    """Multi-level ICI×DCN[×WAN] compressed all-reduce: the cross-slice
+    (and, with ``region_size``, cross-region) schedule.
 
     Every flat communicator above treats the mesh axis as one ring/gather —
     which goes all-DCN the moment the axis crosses an ICI slice (see
@@ -1070,31 +1071,76 @@ class HierarchicalAllreduce(Communicator):
     ``slice_size=None`` (or ``world <= slice_size``) collapses the schedule
     and the model to the flat ring bit-for-bit: one slice, no DCN leg.
 
+    **Three-level (region) schedule**: ``region_size=Rz`` ranks (a whole
+    number of slices, ``Kr = Rz/S`` per region) adds the WAN tier. The
+    cross-slice exchange splits in two: the boundary partial is first
+    summed/aggregated *within the region* over DCN (the ``Kr``-member
+    groups), then the region partial crosses regions over WAN (the
+    ``R``-member groups, ``R = W/Rz``). Exact/homomorphic payloads cross
+    WAN exactly-summable (the zero-requant property one level up —
+    ``wan_compressor`` is rejected for them); requant codecs re-encode the
+    region partial ONCE at the region boundary, optionally through a more
+    *aggressive per-level codec* (``wan_compressor``, itself a
+    ``supports_hop_requant`` codec with a data-free ctx) so the
+    ~100×-slower WAN leg ships ``(R−1)·k_wan/S`` bytes at whatever ratio
+    the WAN budget demands. ``region_size=None`` (or ``world <=
+    region_size``, or a single region after an elastic shrink) collapses
+    the schedule and the model to the two-level one bit-for-bit.
+
     Same enforced gates as Ring: stateless codec, wire payload, data-free
     ctx, and ``summable_payload`` or ``supports_hop_requant``. Requant loss:
-    S−2 intermediate intra-slice hops + 1 slice-boundary encode + 1 final
-    shard encode — the boundary encode is paid once regardless of K (a
-    cross-slice *ring* would pay K−1), which is the point of aggregating
-    the gathered partials locally instead of hopping them. ``world % S != 0``
-    is a trace-time ValueError (an uneven split would silently mis-shard).
+    S−2 intermediate intra-slice hops + 1 slice-boundary encode
+    [+ 1 region-boundary encode when R > 1] + 1 final shard encode — each
+    boundary encode is paid once regardless of Kr/R (a cross-slice or
+    cross-region *ring* would pay a requant per hop), which is the point of
+    aggregating the gathered partials locally instead of hopping them.
+    ``world % S != 0`` / ``world % Rz != 0`` are trace-time ValueErrors (an
+    uneven split would silently mis-shard).
     """
 
     slice_size: Optional[int] = None
+    region_size: Optional[int] = None
+    wan_compressor: Optional[Compressor] = None
     shard_parallel = True
 
     def __post_init__(self):
         if self.slice_size is not None and self.slice_size < 1:
             raise ValueError(f"slice_size must be >= 1 or None; "
                              f"got {self.slice_size}")
+        if self.region_size is not None:
+            if self.slice_size is None:
+                raise ValueError(
+                    "HierarchicalAllreduce(region_size=...) requires "
+                    "slice_size — the region tier groups whole ICI slices, "
+                    "so a three-level schedule without a slice level is "
+                    f"contradictory (got region_size={self.region_size}, "
+                    "slice_size=None).")
+            if (self.region_size < self.slice_size
+                    or self.region_size % self.slice_size):
+                raise ValueError(
+                    f"region_size {self.region_size} must be a whole "
+                    f"multiple of slice_size {self.slice_size} — regions "
+                    "are made of whole slices (the Topology contract).")
+        if self.wan_compressor is not None and self.region_size is None:
+            raise ValueError(
+                "HierarchicalAllreduce(wan_compressor=...) without "
+                "region_size — there is no WAN level to re-encode for; "
+                "set region_size or drop the WAN codec.")
 
     def shrunk(self, topology: Topology) -> "HierarchicalAllreduce":
         """The communicator for a post-resize world described by
         ``topology`` (typically :meth:`grace_tpu.core.Topology.shrink`'s
-        result): same axis, the surviving slice width. A whole-slice loss
-        keeps ``slice_size`` — the K→K−1 resize never touches the
-        intra-slice schedule — while a partial-slice loss hands back the
-        flat ring (``slice_size=None``), matching the topology collapse."""
-        return dataclasses.replace(self, slice_size=topology.slice_size)
+        result): same axis, the surviving tier widths. A whole-region loss
+        keeps both tiers (R→R−1 never touches intra-region schedule); a
+        whole-slice loss keeps ``slice_size`` (K→K−1); a partial-slice
+        loss hands back the flat ring — matching the topology collapse.
+        The WAN codec rides along only while a region tier survives (a
+        two-level or flat schedule has no WAN leg to encode for)."""
+        wan = self.wan_compressor if topology.region_size is not None \
+            else None
+        return dataclasses.replace(self, slice_size=topology.slice_size,
+                                   region_size=topology.region_size,
+                                   wan_compressor=wan)
 
     def _split(self, world: int) -> tuple[int, int]:
         """(intra-slice size S, slice count K) for this world. Static."""
@@ -1110,37 +1156,98 @@ class HierarchicalAllreduce(Communicator):
                 "slice_size to the physical slice width.")
         return s, world // s
 
+    def _split3(self, world: int) -> tuple[int, int, int]:
+        """(S intra-slice, Kr slices per region, R regions). Static.
+        ``R == 1`` is the two-level schedule (and ``Kr`` its K); a world
+        inside one region never pays a WAN leg."""
+        s, k = self._split(world)
+        rz = self.region_size
+        if rz is None or k == 1 or world <= rz:
+            return s, k, 1
+        if world % rz:
+            raise ValueError(
+                f"HierarchicalAllreduce(region_size={rz}) does not divide "
+                f"world size {world} — the three-level schedule needs "
+                "whole regions (ranks [r*Rz, (r+1)*Rz) per region); run "
+                "on a world that is a multiple of region_size or adjust "
+                "region_size to the physical region width.")
+        return s, rz // s, world // rz
+
+    def _wan_leg_nbytes(self, payload_nbytes: int, n_elems: int,
+                        s: int, r: int) -> int:
+        """Per-rank WAN-leg bytes: (R−1) region partials of one shard.
+        With a ``wan_compressor`` the shard crosses at the WAN codec's own
+        payload width (sized on the padded float32 shard — the dtype every
+        registered config's compensated gradient carries), else at the
+        base payload's per-shard share."""
+        if r <= 1:
+            return 0
+        per = payload_nbytes // max(1, s)
+        if self.wan_compressor is not None:
+            from grace_tpu.utils.metrics import payload_nbytes as _pnb
+            n = int(n_elems)
+            shard = (n + (-n) % max(1, s)) // max(1, s)
+            per = int(_pnb(self.wan_compressor,
+                           jax.ShapeDtypeStruct((shard,), jnp.float32)))
+        return (r - 1) * per
+
     def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
                           world: int, vote: bool = False) -> int:
-        s, k = self._split(world)
+        s, kr, r = self._split3(world)
         # (S-1) intra hops + (S-1) gathered shards of ~payload/S each over
-        # ICI; (K-1) cross-slice partials of ~payload/S over DCN.
+        # ICI; (Kr-1) cross-slice partials of ~payload/S over DCN; (R-1)
+        # cross-region partials over WAN (at the WAN codec's width when one
+        # is armed). R == 1 reduces to the committed two-level formula
+        # bit-for-bit (Kr is then the full slice count K).
         intra = 2 * payload_nbytes * (s - 1) // max(1, s)
-        cross = (k - 1) * payload_nbytes // max(1, s)
-        return intra + cross
+        dcn = (kr - 1) * payload_nbytes // max(1, s)
+        return intra + dcn + self._wan_leg_nbytes(payload_nbytes, n_elems,
+                                                  s, r)
 
     def recv_link_bytes(self, payload_nbytes: int, n_elems: int, world: int,
                         topology=None, vote: bool = False) -> LinkBytes:
-        """The first genuinely mixed (ici, dcn) split: intra-slice legs ride
-        ICI, the cross-slice gather rides DCN — *when the schedule's slice
-        grouping nests inside the physical one*. A mismatched layout (comm
-        slices straddling physical slice boundaries) degrades to the flat
-        communicators' all-DCN critical path, honestly."""
+        """The genuinely mixed (ici, dcn, wan) split: intra-slice legs ride
+        ICI, the cross-slice gather rides DCN, the cross-region gather
+        rides WAN — *when the schedule's groupings nest inside the physical
+        ones*. A mismatched layout degrades tier by tier to the flat
+        communicators' worst-boundary critical path, honestly: comm slices
+        straddling physical slices price everything at the worst tier the
+        axis spans; comm regions straddling physical regions (or a
+        two-level schedule on a three-tier fleet) price the whole
+        cross-slice traffic at WAN, because some group member's incoming
+        link is a region boundary."""
         total = int(self._recv_total_bytes(payload_nbytes, n_elems, world,
                                            vote=vote))
         topo = topology if topology is not None else SINGLE_SLICE
         if not topo.crosses_dcn(world):
             return LinkBytes(ici=total, dcn=0)
-        s, k = self._split(world)
+        s, kr, r = self._split3(world)
+        k = kr * r
         aligned = (k > 1 and topo.slice_size is not None
                    and s <= topo.slice_size and topo.slice_size % s == 0)
         if not aligned:
             # k == 1: the comm thinks the axis is one slice but it
-            # physically is not — its "intra-slice" ring crosses DCN,
-            # exactly the flat-ring indictment.
+            # physically is not — its "intra-slice" ring crosses the worst
+            # boundary the axis spans, exactly the flat-ring indictment.
+            if topo.crosses_wan(world):
+                return LinkBytes(ici=0, dcn=0, wan=total)
             return LinkBytes(ici=0, dcn=total)
         intra = 2 * payload_nbytes * (s - 1) // max(1, s)
-        return LinkBytes(ici=intra, dcn=total - intra)
+        cross = total - intra
+        if not topo.crosses_wan(world):
+            # No physical WAN boundary inside this axis: both cross legs
+            # (if the schedule even has two) ride DCN.
+            return LinkBytes(ici=intra, dcn=cross)
+        region_aligned = (r > 1 and topo.region_size is not None
+                          and self.region_size <= topo.region_size
+                          and topo.region_size % self.region_size == 0)
+        if not region_aligned:
+            # A two-level schedule on a three-tier fleet (or comm regions
+            # straddling physical regions): every cross-slice group spans
+            # a region boundary, so the whole cross bill lands on WAN.
+            return LinkBytes(ici=intra, dcn=0, wan=cross)
+        dcn_leg = (kr - 1) * payload_nbytes // max(1, s)
+        return LinkBytes(ici=intra, dcn=dcn_leg, wan=cross - dcn_leg)
 
     def step(self, x: jax.Array, mem_state, comp_state,
              memory, compressor: Compressor, rng: jax.Array):
@@ -1167,9 +1274,30 @@ class HierarchicalAllreduce(Communicator):
                 "payload carries structure a partial sum destroys. Use "
                 "Allgather (general-purpose) or TwoShotAllreduce instead.")
         w = axis_size(self.axis_name)            # static at trace time
-        s, k = self._split(w)
-        # The full two-level sum spans W = K·S ranks (S-term intra-slice
-        # partials, K of them summed at the boundary), so the shared-scale
+        s, kr, r = self._split3(w)
+        k = kr * r
+        if self.wan_compressor is not None:
+            if exact:
+                raise TypeError(
+                    f"HierarchicalAllreduce(wan_compressor="
+                    f"{type(self.wan_compressor).__name__}) with "
+                    f"{type(compressor).__name__}: exact/homomorphic "
+                    "payloads cross WAN exactly-summable — that zero-"
+                    "requant property is the whole reason to use them, and "
+                    "a WAN re-encode would break the payload-space sum "
+                    "while adding loss. Drop wan_compressor, or pair it "
+                    "with a supports_hop_requant base codec.")
+            if not getattr(self.wan_compressor, "supports_hop_requant",
+                           False):
+                raise TypeError(
+                    "HierarchicalAllreduce wan_compressor re-encodes the "
+                    "region partial at the region boundary — a hop requant "
+                    "one level up — so it must declare "
+                    "supports_hop_requant (topk/qsgd/signsgd); "
+                    f"{type(self.wan_compressor).__name__} does not.")
+        # The full multi-level sum spans W = R·Kr·S ranks (S-term
+        # intra-slice partials, Kr of them summed at the slice boundary, R
+        # region partials summed across WAN), so the shared-scale
         # accumulator bound is on W — not S — exactly as the static gate
         # prices it.
         if homo:
@@ -1210,10 +1338,24 @@ class HierarchicalAllreduce(Communicator):
         # this the flat ring permutation bit-for-bit.
         perm_intra = [(j, (j // s) * s + ((j % s) + 1) % s)
                       for j in range(w)]
-        # Rank groups of the two grouped collectives: cross-slice peers
-        # share a local index; intra-slice peers share a slice.
+        # Rank groups of the grouped collectives: cross-slice peers share
+        # a local index; intra-slice peers share a slice. With a region
+        # tier (R > 1) the cross-slice exchange splits level-by-level:
+        # dcn_groups are the Kr slices of ONE region sharing a local index
+        # (all-DCN), wan_groups one rank per region sharing (slice-in-
+        # region, local) — by then every rank of a dcn group holds the
+        # identical region partial, so any one member per region
+        # represents it and the grouping stays a partition of the axis.
         cross_groups = [[kk * s + ll for kk in range(k)] for ll in range(s)]
         intra_groups = [[kk * s + ll for ll in range(s)] for kk in range(k)]
+        if r > 1:
+            rz = kr * s
+            dcn_groups = [[rho * rz + kk * s + ll for kk in range(kr)]
+                          for rho in range(r) for ll in range(s)]
+            wan_groups = [[rho * rz + kk * s + ll for rho in range(r)]
+                          for kk in range(kr) for ll in range(s)]
+        else:
+            dcn_groups, wan_groups = cross_groups, None
 
         def take_payload(stack, c):
             return tuple(jnp.take(t, c, axis=0) for t in stack)
@@ -1250,7 +1392,7 @@ class HierarchicalAllreduce(Communicator):
             # DCN.
             if k > 1:
                 stacked = gather_groups(
-                    partial, cross_groups,
+                    partial, dcn_groups,
                     f"{STAGE_EXCHANGE}/hier_cross_slice")
                 # dtype pinned to the wire dtype: numpy promotion would
                 # silently widen integer level sums to int32 here, but the
@@ -1258,6 +1400,16 @@ class HierarchicalAllreduce(Communicator):
                 # (payload_sum_max_world bounds W so THIS dtype is enough).
                 owned = tuple(jnp.sum(t, axis=0, dtype=t.dtype)
                               for t in stacked)
+                if r > 1:
+                    # Level 3: the region partials cross WAN still in
+                    # payload space — the exact/homomorphic algebra makes
+                    # the (R-1)-partial WAN exchange a zero-requant sum,
+                    # one tier up from the slice-boundary argument.
+                    stacked_w = gather_groups(
+                        owned, wan_groups,
+                        f"{STAGE_EXCHANGE}/hier_cross_region")
+                    owned = tuple(jnp.sum(t, axis=0, dtype=t.dtype)
+                                  for t in stacked_w)
             else:
                 owned = partial
             if compressor.average and not homo:
@@ -1314,18 +1466,48 @@ class HierarchicalAllreduce(Communicator):
                                                 shard_ctx(0))
             if k > 1:
                 # The ONE slice-boundary requant: re-encode the slice
-                # partial under a shared key, gather the K encoded partials
-                # across slices over DCN, decode and aggregate locally
-                # (sum, or the majority vote for sign codecs — every rank
-                # of a cross-slice group computes the identical result).
+                # partial under a shared key, gather the Kr encoded
+                # partials across the region's slices over DCN, decode and
+                # aggregate locally (sum, or the majority vote for sign
+                # codecs — every rank of a cross-slice group computes the
+                # identical result).
                 payload_b, ctx_b, _ = compressor.compress(
                     partial, None, jax.random.fold_in(rng, 2 * s))
                 stacked = gather_groups(
-                    tuple(payload_b), cross_groups,
+                    tuple(payload_b), dcn_groups,
                     f"{STAGE_EXCHANGE}/hier_cross_slice")
                 decoded = jax.vmap(
                     lambda p: compressor.decompress(p, ctx_b))(stacked)
                 agg = compressor.aggregate(decoded)
+                if r > 1:
+                    # The ONE region-boundary requant, one level up: every
+                    # rank of a dcn group now holds the identical region
+                    # partial, so re-encode it — through the aggressive
+                    # WAN codec when one is armed, else the base codec —
+                    # under a shared key, gather the R encoded region
+                    # partials across regions over WAN, decode and
+                    # aggregate with the BASE codec's semantics (sum, or
+                    # the cascaded majority vote). Paid once regardless of
+                    # R; a cross-region ring would pay R-1 requants.
+                    wan_codec = self.wan_compressor or compressor
+                    if (self.wan_compressor is not None
+                            and not ctx_is_data_free(
+                                self.wan_compressor, agg.size, agg.dtype)):
+                        raise TypeError(
+                            "HierarchicalAllreduce wan_compressor needs a "
+                            "data-free ctx — ranks decode each other's "
+                            "region partials with locally derived ctx; "
+                            f"{type(self.wan_compressor).__name__}."
+                            "compress puts data-derived arrays in ctx.")
+                    payload_w, ctx_w, _ = wan_codec.compress(
+                        agg.astype(chunks.dtype), None,
+                        jax.random.fold_in(rng, 2 * s + 2))
+                    stacked_w = gather_groups(
+                        tuple(payload_w), wan_groups,
+                        f"{STAGE_EXCHANGE}/hier_cross_region")
+                    decoded_w = jax.vmap(
+                        lambda p: wan_codec.decompress(p, ctx_w))(stacked_w)
+                    agg = compressor.aggregate(decoded_w)
             else:
                 # Singleton stack: sum codecs pass through, vote codecs
                 # re-sign the final tally — same as the flat ring.

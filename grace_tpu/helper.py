@@ -207,9 +207,16 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
         # slice_size: ranks [k*S, (k+1)*S) form one ICI slice; the
         # two-level ICI×DCN schedule (intra-slice ring reduce-scatter,
         # cross-slice partial exchange, intra-slice all-gather). None
-        # collapses to the flat ring (one slice).
+        # collapses to the flat ring (one slice). region_size adds the
+        # third (WAN) level; wan_compressor is a nested params dict
+        # naming the aggressive cross-region codec.
+        wan_params = params.get("wan_compressor")
+        wan = (_build_compressor(dict(wan_params), axis)
+               if isinstance(wan_params, dict) else None)
         return comm.HierarchicalAllreduce(
-            axis_name=axis, slice_size=params.get("slice_size"))
+            axis_name=axis, slice_size=params.get("slice_size"),
+            region_size=params.get("region_size"),
+            wan_compressor=wan)
     if name in ("sign_allreduce", "signallreduce"):
         return comm.SignAllreduce(
             axis_name=axis,
@@ -278,11 +285,13 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
         else:
             raise ValueError(f"unknown escape compressor {escape!r} — use "
                              "'none'/'dense', 'fp16', or 'bf16'")
-    # slice_size also declares the mesh link layout: the telemetry ring's
-    # per-link wire split (wire_bytes_ici/wire_bytes_dcn) prices against
-    # the Topology it implies. Without it the layout is auto-detected
-    # (Topology.detect) — single slice on CPU/simulated meshes.
+    # slice_size/region_size also declare the mesh link layout: the
+    # telemetry ring's per-link wire split (wire_bytes_ici/dcn/wan)
+    # prices against the Topology they imply. Without them the layout is
+    # auto-detected (Topology.detect) — single slice on CPU/simulated
+    # meshes.
     slice_size = params.get("slice_size")
+    region_size = params.get("region_size")
     fsdp_axis = params.get("fsdp_axis")
     mesh = (MeshSpec(dp_axis=axis, fsdp_axis=str(fsdp_axis))
             if fsdp_axis else None)
@@ -337,8 +346,10 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
                  escape=escape,
                  mesh=mesh,
                  routes=routes,
-                 topology=(Topology(slice_size=int(slice_size))
-                           if slice_size else None),
+                 topology=(Topology(
+                     slice_size=int(slice_size) if slice_size else None,
+                     region_size=int(region_size) if region_size else None)
+                           if (slice_size or region_size) else None),
                  # True | ring capacity | {"capacity": ..,
                  # "compression_error": ..} — see grace_transform(telemetry=)
                  telemetry=params.get("telemetry"),
@@ -383,7 +394,7 @@ def routed_recv_link_bytes(grace: Grace, tree, world: int,
     from grace_tpu.utils.metrics import payload_nbytes
     import numpy as np
 
-    ici = dcn = 0
+    ici = dcn = wan = 0
     for _p, s, comp, _mem, cm in route_leaves(grace, tree):
         ne = int(np.prod(s.shape, dtype=np.int64))
         vote = bool(getattr(comp, "vote_aggregate", False))
@@ -391,10 +402,12 @@ def routed_recv_link_bytes(grace: Grace, tree, world: int,
                                 topology=topology, vote=vote)
         neg = negotiation_bytes_for(comp, ne, world)
         topo = topology if topology is not None else Topology()
-        if neg and topo.crosses_dcn(world):
-            lb = LinkBytes(ici=lb.ici, dcn=lb.dcn + neg)
-        elif neg:
-            lb = LinkBytes(ici=lb.ici + neg, dcn=lb.dcn)
+        if neg:
+            # The negotiation pmax is a flat full-axis collective: its
+            # bytes land on the worst tier the axis spans (flat_tier).
+            tier = topo.flat_tier(world)
+            lb = lb._replace(**{tier: getattr(lb, tier) + neg})
         ici += lb.ici
         dcn += lb.dcn
-    return LinkBytes(ici=ici, dcn=dcn)
+        wan += lb.wan
+    return LinkBytes(ici=ici, dcn=dcn, wan=wan)

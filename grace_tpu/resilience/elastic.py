@@ -32,12 +32,20 @@ the world size W is fixed for the life of the run. This module makes W a
   The re-init is validated statically for free against flow pass 7's
   ``footprint_model`` at the new world (:func:`validate_resharded`).
 
-* **Slice-granular shrink**: under the hierarchical ICI×DCN communicator,
-  losing a whole slice is a K→K−1 DCN-level resize that never touches
-  intra-slice state — :meth:`grace_tpu.core.Topology.shrink` keeps
-  ``slice_size`` for whole-slice losses and collapses to flat for partial
-  ones, and :meth:`grace_tpu.comm.HierarchicalAllreduce.shrunk` rebuilds
-  the communicator to match.
+* **Slice- and region-granular shrink**: under the hierarchical
+  ICI×DCN[×WAN] communicator, losing a whole slice is a K→K−1 DCN-level
+  resize that never touches intra-slice state, and losing a whole region
+  is an R→R−1 WAN-level resize that never touches intra-region state —
+  :meth:`grace_tpu.core.Topology.shrink` keeps ``slice_size`` for
+  whole-slice losses, keeps both tiers for whole-region losses (dropping
+  the WAN tier when a single region remains), and collapses to flat for
+  partial ones; :meth:`grace_tpu.comm.HierarchicalAllreduce.shrunk`
+  rebuilds the communicator to match (the WAN codec is dropped with its
+  tier). A region-wide failure domain — one metro's power event taking S·K
+  ranks at once — is ONE drain → resize → rejoin transition, not S·K
+  independent rank losses: :meth:`ElasticController.region_scope` widens
+  the drain to the whole region once a quorum of its ranks carries skew
+  episodes.
 
 * **Rejoin barrier** (:func:`rejoin_barrier`): a rank rejoining at W was
   restored from a checkpoint the fleet has since trained past — its
@@ -123,9 +131,12 @@ class ResizePlan:
 
     ``survivors`` are old-world rank indices in ascending order — the new
     world's rank k is old rank ``survivors[k]`` (contiguous renumbering,
-    the layout :meth:`Topology.shrink` prices). ``topology`` is the
-    surviving link layout: whole-slice losses keep ``slice_size`` (K→K−1),
-    partial-slice losses collapse to flat.
+    the layout :meth:`Topology.shrink` prices; for whole-region losses the
+    renumbering is region-granular — every surviving region carries its
+    ranks across intact). ``topology`` is the surviving link layout:
+    whole-slice losses keep ``slice_size`` (K→K−1), whole-region losses
+    keep both tiers (R→R−1; the WAN tier is dropped when one region
+    remains), partial losses collapse to flat.
     """
 
     old_world: int
@@ -134,6 +145,7 @@ class ResizePlan:
     survivors: Tuple[int, ...]
     topology: Topology
     whole_slices: bool
+    whole_regions: bool = False
 
 
 def plan_resize(world: int, lost_ranks,
@@ -148,12 +160,20 @@ def plan_resize(world: int, lost_ranks,
     topo = topology if topology is not None else Topology()
     lost = tuple(sorted(set(int(r) for r in lost_ranks)))
     new_topo, new_world = topo.shrink(world, lost)
-    survivors = tuple(r for r in range(world) if r not in set(lost))
+    lost_set = set(lost)
+    survivors = tuple(r for r in range(world) if r not in lost_set)
     whole = (topo.slice_size is not None
              and new_topo.slice_size == topo.slice_size)
+    whole_regions = False
+    if lost and topo.region_size is not None and world % topo.region_size == 0:
+        rz = topo.region_size
+        touched = sorted({r // rz for r in lost})
+        whole_regions = all(rho * rz + i in lost_set
+                            for rho in touched for i in range(rz))
     return ResizePlan(old_world=world, new_world=new_world,
                       lost_ranks=lost, survivors=survivors,
-                      topology=new_topo, whole_slices=whole)
+                      topology=new_topo, whole_slices=whole,
+                      whole_regions=whole_regions)
 
 
 # ---------------------------------------------------------------------------
@@ -394,11 +414,30 @@ class ElasticController:
     emitted as an ``elastic_drain`` / ``elastic_resize`` /
     ``elastic_rejoin`` record into the same JSONL stream as telemetry,
     guard, and consensus events (timeline kind ``elastic``).
+
+    When the controller knows the fleet's link layout (``topology`` with a
+    ``region_size``), a region-wide skew episode — a metro-level network
+    or power event degrading every rank behind one WAN boundary at once —
+    is recognized by :meth:`region_scope` and handled as ONE drain →
+    resize → rejoin transition over the whole region, not ``region_size``
+    independent rank losses (every rank in the scope is marked drained,
+    so later threshold crossings inside the same region are absorbed).
+
+    The drain's checkpoint save runs under a bounded watchdog when
+    ``drain_timeout_s`` is set: a stalled checkpoint backend must not
+    wedge the drain while the flagged rank keeps degrading, so each stall
+    emits an ``elastic_drain_timeout`` record, retries with doubled
+    timeout up to ``drain_retries`` extra attempts, and finally proceeds
+    with the last known good checkpoint already on disk.
     """
 
     def __init__(self, *, consensus=None, checkpointer=None, sink=None,
                  anomaly_threshold: int = 2,
                  anomaly_metrics=("compression_error", "residual_norm"),
+                 topology: Optional[Topology] = None,
+                 region_quorum: float = 0.5,
+                 drain_timeout_s: Optional[float] = None,
+                 drain_retries: int = 1,
                  axis_name: str = DEFAULT_AXIS):
         self.consensus = normalize_consensus(consensus) \
             if consensus not in (None, False) else None
@@ -409,6 +448,20 @@ class ElasticController:
         # grad_norm skews are real data heterogeneity on fixed shards (the
         # chaos_smoke --watch misattribution rationale), not a dying rank.
         self.anomaly_metrics = tuple(anomaly_metrics)
+        self.topology = topology
+        if not (0.0 < float(region_quorum) <= 1.0):
+            raise ValueError(f"region_quorum must be in (0, 1]; "
+                             f"got {region_quorum}")
+        self.region_quorum = float(region_quorum)
+        if drain_timeout_s is not None and float(drain_timeout_s) <= 0:
+            raise ValueError(f"drain_timeout_s must be positive; "
+                             f"got {drain_timeout_s}")
+        self.drain_timeout_s = (float(drain_timeout_s)
+                                if drain_timeout_s is not None else None)
+        if int(drain_retries) < 0:
+            raise ValueError(f"drain_retries must be >= 0; "
+                             f"got {drain_retries}")
+        self.drain_retries = int(drain_retries)
         self.axis_name = axis_name
         self.events: List[dict] = []
         self.episodes: Dict[int, int] = {}
@@ -443,17 +496,95 @@ class ElasticController:
                 return rank
         return None
 
+    def region_scope(self, rank: int) -> Tuple[int, ...]:
+        """The drain scope the flagged rank implies: the whole region's
+        rank tuple when the controller knows a region layout and at least
+        ``region_quorum`` of the region's ranks carry skew episodes (ONE
+        failing domain — drain once, resize R→R−1), else ``(rank,)``."""
+        rank = int(rank)
+        topo = self.topology
+        if topo is None or getattr(topo, "region_size", None) is None:
+            return (rank,)
+        rz = int(topo.region_size)
+        rho = rank // rz
+        members = tuple(range(rho * rz, (rho + 1) * rz))
+        hot = sum(1 for m in members if self.episodes.get(m, 0) > 0)
+        need = max(1, int(np.ceil(self.region_quorum * rz)))
+        return members if hot >= need else (rank,)
+
     # -- lifecycle ----------------------------------------------------------
-    def drain(self, step: int, state, rank: int) -> dict:
-        """Pre-death drain: save the last-known-good checkpoint while the
-        flagged rank is still participating, so the resize restores from
-        a state every healthy rank agreed on."""
-        if self.checkpointer is not None:
+    def _drain_checkpoint(self, step: int, state) -> Tuple[bool, int]:
+        """Save+wait the last-known-good checkpoint under a watchdog.
+
+        Returns ``(checkpointed, timeouts)``. With ``drain_timeout_s``
+        unset the save blocks indefinitely (the pre-region behavior).
+        With it set, each attempt gets a bounded window; a stall emits an
+        ``elastic_drain_timeout`` record and retries with doubled timeout
+        (backoff) up to ``drain_retries`` extra attempts before giving up
+        and proceeding with the last known good checkpoint on disk. The
+        stalled attempt's thread is a daemon — a wedged backend is left
+        behind, never joined on the drain path.
+        """
+        def attempt():
             self.checkpointer.save(step, state, force=True, good=True)
             self.checkpointer.wait()
+
+        if self.drain_timeout_s is None:
+            attempt()
+            return True, 0
+
+        import threading
+        timeout = self.drain_timeout_s
+        timeouts = 0
+        for trial in range(self.drain_retries + 1):
+            done = threading.Event()
+            errs: List[BaseException] = []
+
+            def run():
+                try:
+                    attempt()
+                except BaseException as e:   # noqa: BLE001 — re-raised below
+                    errs.append(e)
+                finally:
+                    done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+            if done.wait(timeout):
+                if errs:
+                    raise errs[0]
+                return True, timeouts
+            timeouts += 1
+            last_good = None
+            if hasattr(self.checkpointer, "last_good_step"):
+                try:
+                    last_good = self.checkpointer.last_good_step()
+                except Exception:
+                    last_good = None
+            self._emit("elastic_drain_timeout", step, attempt=trial + 1,
+                       timeout_s=float(timeout),
+                       retries_left=self.drain_retries - trial,
+                       last_good_step=last_good)
+            timeout *= 2.0
+        return False, timeouts
+
+    def drain(self, step: int, state, rank: int, scope=None) -> dict:
+        """Pre-death drain: save the last-known-good checkpoint while the
+        flagged scope is still participating, so the resize restores from
+        a state every healthy rank agreed on. ``scope`` widens the drain
+        beyond the flagged rank (pass :meth:`region_scope`'s result for
+        region-wide episodes); every rank in it is marked drained so the
+        same failing domain never triggers a second transition."""
+        scope = (tuple(int(r) for r in scope)
+                 if scope is not None else (int(rank),))
+        self.drained_ranks.update(scope)
+        checkpointed, timeouts = (self._drain_checkpoint(step, state)
+                                  if self.checkpointer is not None
+                                  else (False, 0))
         return self._emit("elastic_drain", step, rank=int(rank),
+                          scope=list(scope),
                           episodes=self.episodes.get(int(rank), 0),
-                          checkpointed=self.checkpointer is not None)
+                          checkpointed=checkpointed,
+                          drain_timeouts=timeouts)
 
     def resize(self, step: int, state, optimizer, old_mesh, new_mesh,
                plan: ResizePlan, grace=None, params=None) -> Tuple[Any,
@@ -472,7 +603,9 @@ class ElasticController:
             old_world=plan.old_world, new_world=plan.new_world,
             lost_ranks=list(plan.lost_ranks),
             slice_size=plan.topology.slice_size,
+            region_size=plan.topology.region_size,
             whole_slices=plan.whole_slices,
+            whole_regions=plan.whole_regions,
             footprint_matches=footprint_ok)
         return new_state, event
 

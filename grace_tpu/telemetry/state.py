@@ -72,12 +72,18 @@ FIELDS = (
     ("wire_bytes_dcn", "first"),    # the transform's Topology
                                     # (Communicator.recv_link_bytes): flat
                                     # communicators are all-ICI within one
-                                    # slice and all-DCN beyond it; the
-                                    # hierarchical comm reports a mixed
-                                    # split. ici + dcn == the exchange's
+                                    # slice, all-DCN beyond it, and all-WAN
+                                    # beyond one region; the hierarchical
+                                    # comm reports a mixed split.
+                                    # ici + dcn + wan == the exchange's
                                     # wire_bytes (on audit steps the scalar
                                     # additionally carries audit_bytes,
                                     # which are not split by link)
+    ("wire_bytes_wan", "first"),    # the third ordered tier of the same
+                                    # split: cross-region traffic under a
+                                    # Topology(region_size=...) — zero on
+                                    # every 2-tier layout, so pre-region
+                                    # readers see identical ici/dcn values
     ("watch_bytes", "first"),       # graft-watch health-gather wire cost
                                     # this step (telemetry/aggregate.py):
                                     # non-zero on window-boundary steps

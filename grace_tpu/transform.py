@@ -1037,7 +1037,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
         topo = resolved_topology
         structs = [jax.ShapeDtypeStruct(tuple(jnp.shape(l)),
                                         jnp.result_type(l)) for l in leaves]
-        dense = n_elems = ici = dcn = neg_b = 0
+        dense = n_elems = ici = dcn = wan = neg_b = 0
         for s, (comp_i, _mem_i, cm_i) in zip(structs, triads):
             ne = int(np.prod(s.shape, dtype=np.int64))
             dense += ne * s.dtype.itemsize
@@ -1047,8 +1047,9 @@ def grace_transform(compressor: Compressor, memory: Memory,
                                       topology=topo, vote=vote_i)
             ici += lb.ici
             dcn += lb.dcn
+            wan += lb.wan
             neg_b += negotiation_bytes_for(comp_i, ne, world)
-        link = LinkBytes(ici=ici, dcn=dcn)
+        link = LinkBytes(ici=ici, dcn=dcn, wan=wan)
         if escape is not None:
             esc_b = sum(payload_nbytes(escape, s) for s in structs)
             esc_link = Allreduce(
@@ -1118,7 +1119,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
             # in tests/test_bucketed.py; still inside WIRE_MODEL_RTOL of
             # the whole-payload recv_wire_bytes the auditor reconciles.
             from grace_tpu.utils.metrics import payload_nbytes
-            ici = dcn = 0
+            ici = dcn = wan = 0
             for s, count in fusion_payload_structs(structs, fusion):
                 b_elems = int(np.prod(s.shape, dtype=np.int64))
                 lb = communicator.recv_link_bytes(
@@ -1126,7 +1127,8 @@ def grace_transform(compressor: Compressor, memory: Memory,
                     topology=topo, vote=vote)
                 ici += count * lb.ici
                 dcn += count * lb.dcn
-            link = LinkBytes(ici=ici, dcn=dcn)
+                wan += count * lb.wan
+            link = LinkBytes(ici=ici, dcn=dcn, wan=wan)
         else:
             link = communicator.recv_link_bytes(comp_b, n_elems, world,
                                                 topology=topo, vote=vote)
@@ -1275,19 +1277,26 @@ def grace_transform(compressor: Compressor, memory: Memory,
             rung_dcn = jnp.asarray(
                 [float(esc_link.dcn)]
                 + [float(p[1].dcn) for p in rung_plans], jnp.float32)
+            rung_wan = jnp.asarray(
+                [float(esc_link.wan)]
+                + [float(p[1].wan) for p in rung_plans], jnp.float32)
             rung_neg = jnp.asarray(
                 [0.0] + [float(p[3]) for p in rung_plans], jnp.float32)
             eff = rung_tot[eff_idx]
             eff_ici = rung_ici[eff_idx]
             eff_dcn = rung_dcn[eff_idx]
+            eff_wan = rung_wan[eff_idx]
             ngb = rung_neg[eff_idx]
             # The signal reductions run every step — two scalar
             # full-axis collectives, folded like watch_bytes (flat
-            # schedule: ICI within one slice, DCN beyond).
+            # schedule: ICI within one slice, DCN beyond, WAN beyond one
+            # region — Topology.flat_tier).
             ab = jnp.asarray(float(adapt_signal_bytes(world)), jnp.float32)
-            topo = resolved_topology
+            tier = resolved_topology.flat_tier(world)
             eff = eff + ngb + ab
-            if topo.crosses_dcn(world):
+            if tier == "wan":
+                eff_wan = eff_wan + ngb + ab
+            elif tier == "dcn":
                 eff_dcn = eff_dcn + ngb + ab
             else:
                 eff_ici = eff_ici + ngb + ab
@@ -1295,6 +1304,7 @@ def grace_transform(compressor: Compressor, memory: Memory,
             eff = jnp.asarray(float(comp_b), jnp.float32)
             eff_ici = jnp.asarray(float(link.ici), jnp.float32)
             eff_dcn = jnp.asarray(float(link.dcn), jnp.float32)
+            eff_wan = jnp.asarray(float(link.wan), jnp.float32)
         else:
             fb = jnp.asarray(state.fallback, jnp.bool_)
             eff = jnp.where(fb, jnp.asarray(float(esc_b), jnp.float32),
@@ -1307,6 +1317,9 @@ def grace_transform(compressor: Compressor, memory: Memory,
             eff_dcn = jnp.where(
                 fb, jnp.asarray(float(esc_link.dcn), jnp.float32),
                 jnp.asarray(float(link.dcn), jnp.float32))
+            eff_wan = jnp.where(
+                fb, jnp.asarray(float(esc_link.wan), jnp.float32),
+                jnp.asarray(float(link.wan), jnp.float32))
         if eff_idx is None:
             # Shared-scale negotiation cost, folded like watch_bytes —
             # into the scalar AND the per-link split (the pmax is a flat
@@ -1320,9 +1333,11 @@ def grace_transform(compressor: Compressor, memory: Memory,
                                 jnp.zeros((), jnp.float32), ngb)
             if neg_b:
                 world = _bound_axis_size(communicator.axis_name)
-                topo = resolved_topology
+                tier = resolved_topology.flat_tier(world)
                 eff = eff + ngb
-                if topo.crosses_dcn(world):
+                if tier == "wan":
+                    eff_wan = eff_wan + ngb
+                elif tier == "dcn":
                     eff_dcn = eff_dcn + ngb
                 else:
                     eff_ici = eff_ici + ngb
@@ -1347,13 +1362,16 @@ def grace_transform(compressor: Compressor, memory: Memory,
                 # Fold the gather's received bytes into the effective wire
                 # accounting — the same honesty contract as audit_bytes,
                 # but split by link too: the health gather is a flat
-                # full-axis collective, so it rides ICI within one slice
-                # and DCN beyond it, exactly like the escape psum.
-                topo = resolved_topology
+                # full-axis collective, so it rides ICI within one slice,
+                # DCN beyond it, and WAN beyond one region — exactly like
+                # the escape psum (Topology.flat_tier).
+                tier = resolved_topology.flat_tier(world)
                 wb = jnp.where(due, jnp.asarray(
                     float(watch_gather_bytes(world)), jnp.float32), 0.0)
                 eff = eff + wb
-                if topo.crosses_dcn(world):
+                if tier == "wan":
+                    eff_wan = eff_wan + wb
+                elif tier == "dcn":
                     eff_dcn = eff_dcn + wb
                 else:
                     eff_ici = eff_ici + wb
@@ -1370,12 +1388,13 @@ def grace_transform(compressor: Compressor, memory: Memory,
             # the audit runs post-apply, after this row is written.
             "audit_bytes": jnp.zeros((), jnp.float32),
             # Per-link split of the exchange's wire_bytes under the
-            # transform's Topology; ici + dcn == wire_bytes on every
+            # transform's Topology; ici + dcn + wan == wire_bytes on every
             # non-audit step (the consensus hook folds its flat-collective
             # audit cost into the scalar only; the watch gather is folded
             # into scalar AND split, so the identity survives it).
             "wire_bytes_ici": eff_ici,
             "wire_bytes_dcn": eff_dcn,
+            "wire_bytes_wan": eff_wan,
             "watch_bytes": wb,
             "negotiation_bytes": ngb,
             # graft-adapt: the effective rung this row's bytes were

@@ -73,7 +73,7 @@ def run_tune(topologies: Sequence[Union[str, TuneTopology]], *,
         raise ValueError("at least one topology is required")
     target = specs[0]
     structs = model_structs(model)
-    ici_bw, dcn_bw, projection_model = projection_constants()
+    ici_bw, dcn_bw, wan_bw, projection_model = projection_constants()
 
     static: Dict[str, Any] = {}
     candidates_by_name: Dict[str, Candidate] = {}
@@ -89,14 +89,17 @@ def run_tune(topologies: Sequence[Union[str, TuneTopology]], *,
         "tool": "graft_tune",
         "model": model,
         "topologies": [{"world": s.world, "slice_size": s.slice_size,
+                        "region_size": s.region_size,
                         "label": s.label} for s in specs],
         "target": target.label,
         "cost_model": {
             "ici_bytes_per_s": ici_bw,
             "dcn_bytes_per_s": dcn_bw,
+            "wan_bytes_per_s": wan_bw,
             "rule": "projected_step = base_compute_step + ici_bytes/ICI_BW"
-                    " + dcn_bytes/DCN_BW (per-link recv_link_bytes under "
-                    "the target Topology; see grace_tpu/tuning/cost.py)",
+                    " + dcn_bytes/DCN_BW + wan_bytes/WAN_BW (per-link "
+                    "recv_link_bytes under the target Topology; see "
+                    "grace_tpu/tuning/cost.py)",
             "constants_source": projection_model["constants_source"],
         },
         "static": static,

@@ -162,6 +162,26 @@ def generated_variants(spec: TuneTopology) -> List[Candidate]:
                       {**homoq, "communicator": "hier", "slice_size": s,
                        "fusion": "flat"}, source="generated"),
         ]
+    rz = spec.region_size
+    if s is not None and rz is not None and spec.world > rz:
+        # Three-tier target (ISSUE 16): the three-level schedule at the
+        # target's own (slice, region) widths. The topk variant arms the
+        # aggressive per-level WAN codec (a deeper-ratio topk re-encode of
+        # the region partial — ONE boundary requant); the homomorphic one
+        # crosses WAN exactly-summable and must not (gate-enforced).
+        out += [
+            Candidate(f"tune-topk1pct-hier{s}r{rz}",
+                      {**topk, "communicator": "hier", "slice_size": s,
+                       "region_size": rz, "fusion": "flat",
+                       "wan_compressor": {"compressor": "topk",
+                                          "compress_ratio": 0.001,
+                                          "topk_algorithm": "chunk"}},
+                      source="generated"),
+            Candidate(f"tune-homoqsgd4-hier{s}r{rz}",
+                      {**homoq, "communicator": "hier", "slice_size": s,
+                       "region_size": rz, "fusion": "flat"},
+                      source="generated"),
+        ]
     return out
 
 
@@ -322,4 +342,18 @@ def variant_audit_entries() -> List[Tuple[str, Dict[str, Any], str]]:
          {"compressor": "homoqsgd", "quantum_num": 7, "memory": "residual",
           "communicator": "rscatter", "fusion": "flat"},
          "homomorphic payload-space sum over the rscatter schedule"),
+        # The three-tier funnel's WAN-recompression leg (ISSUE 16): the
+        # aggressive per-level codec that re-selects the slice-boundary
+        # payload before it crosses the region boundary. slice_size=2 +
+        # region_size=4 puts both boundaries inside the 8-way audit mesh,
+        # so wire_reconciliation prices the narrowed WAN leg against
+        # recv_link_bytes' p_wan while ici/dcn stay at the base width.
+        ("tune-topk1pct-hier3-wan",
+         {"compressor": "topk", "compress_ratio": 0.25,
+          "topk_algorithm": "chunk", "memory": "residual",
+          "communicator": "hier", "slice_size": 2, "region_size": 4,
+          "fusion": "flat",
+          "wan_compressor": {"compressor": "topk", "compress_ratio": 0.05,
+                             "topk_algorithm": "chunk"}},
+         "aggressive WAN re-compression over the three-level hier schedule"),
     ]

@@ -2,25 +2,29 @@
 
 One pricing rule, stated once and stamped into every ``TUNE_LAST.json``:
 
-    projected_step = base_compute_step
-                     + ici_bytes / ICI_BW + dcn_bytes / DCN_BW
+    projected_step = base_compute_step + ici_bytes / ICI_BW
+                     + dcn_bytes / DCN_BW + wan_bytes / WAN_BW
 
-where ``(ici_bytes, dcn_bytes)`` is :meth:`Communicator.recv_link_bytes`
-under the *target* :class:`~grace_tpu.core.Topology` — the same shared
-per-link wire model the bench projections, the telemetry ring and the
-static auditor's wire-reconciliation pass already agree on — and the
-bandwidth constants are ``bench.PROJECTION_MODEL``'s public per-chip
-numbers (ICI ~90 GB/s, DCN ~25 GB/s), imported, not duplicated, so the
-tuner and the bench can never price the same bytes differently.
+where ``(ici_bytes, dcn_bytes, wan_bytes)`` is
+:meth:`Communicator.recv_link_bytes` under the *target*
+:class:`~grace_tpu.core.Topology` — the same shared per-link wire model
+the bench projections, the telemetry ring and the static auditor's
+wire-reconciliation pass already agree on — and the bandwidth constants
+are ``bench.PROJECTION_MODEL``'s public per-chip numbers (ICI ~90 GB/s,
+DCN ~25 GB/s, WAN ~0.25 GB/s — the documented cross-region model
+assumption), imported, not duplicated, so the tuner and the bench can
+never price the same bytes differently.
 
-Why ICI and DCN legs are priced separately: a flat communicator's
-critical-path rank receives every pipelined chunk over the slice-boundary
-link the moment the axis crosses slices, so its whole bill lands on the
-~3.6×-slower DCN; the hierarchical communicator's mixed split keeps the
-2·k·(S−1)/S intra-slice legs on ICI and ships only (K−1)·k/S across DCN.
-Collapsing the two legs into one bandwidth erases exactly the distinction
-the topology-aware selection exists to exploit (ScaleCom's W-dependent
-topk degradation, EQuARX's per-topology tuning — PAPERS.md).
+Why the legs are priced separately: a flat communicator's critical-path
+rank receives every pipelined chunk over the worst boundary link the
+moment the axis crosses it, so its whole bill lands on the ~3.6×-slower
+DCN — or the ~100×-below-DCN WAN once the axis spans regions; the
+hierarchical communicator's mixed split keeps the 2·k·(S−1)/S intra-slice
+legs on ICI, ships (K/R−1)·k/S across DCN, and only (R−1) aggressively
+re-coded shards across WAN. Collapsing the legs into one bandwidth erases
+exactly the distinction the topology-aware selection exists to exploit
+(ScaleCom's W-dependent topk degradation, EQuARX's per-topology tuning —
+PAPERS.md).
 
 Model limits (recorded in the evidence, enforced by the measured stage):
 
@@ -63,31 +67,35 @@ def _bench_module():
 
 
 def projection_constants():
-    """(ici_bytes_per_s, dcn_bytes_per_s, projection_model_doc) — the ONE
-    set of bandwidth assumptions, owned by bench.py."""
+    """(ici_bytes_per_s, dcn_bytes_per_s, wan_bytes_per_s,
+    projection_model_doc) — the ONE set of bandwidth assumptions, owned
+    by bench.py."""
     bench = _bench_module()
     return (bench.ICI_RING_BYTES_PER_S, bench.DCN_BYTES_PER_S,
-            bench.PROJECTION_MODEL)
+            bench.WAN_BYTES_PER_S, bench.PROJECTION_MODEL)
 
 
 @dataclasses.dataclass(frozen=True)
 class TuneTopology:
     """The tuner's target mesh: dp world size + ICI slice width + optional
-    fsdp width (the 2-D sharded-model mesh).
+    region width and fsdp width (the 2-D sharded-model mesh).
 
     ``slice_size=None`` is a single ICI slice of any width (the regime
     every committed single-chip measurement ran in); ``W=256, slice8`` is
-    the xslice projection topology. Parsed from the CLI's ``W`` /
-    ``W,slice_size`` / ``dp×fsdp[,slice_size]`` spelling (``64x4,8`` =
-    dp=64 × fsdp=4, slices of 8). ``world`` is the EXCHANGE (dp) axis
-    size — the span every wire/numeric model prices, because the
-    compressed collective is the per-shard reduce over dp; ``fsdp``
-    multiplies the device count without widening any priced collective.
+    the xslice projection topology; a third spec part adds the WAN tier
+    (``1024,8,256`` = 4 regions of 256 ranks, 32 slices of 8 each).
+    Parsed from the CLI's ``W`` / ``W,slice_size[,region_size]`` /
+    ``dp×fsdp[,slice_size[,region_size]]`` spelling (``64x4,8`` = dp=64 ×
+    fsdp=4, slices of 8). ``world`` is the EXCHANGE (dp) axis size — the
+    span every wire/numeric model prices, because the compressed
+    collective is the per-shard reduce over dp; ``fsdp`` multiplies the
+    device count without widening any priced collective.
     """
 
     world: int
     slice_size: Optional[int] = None
     fsdp: Optional[int] = None
+    region_size: Optional[int] = None
 
     def __post_init__(self):
         if self.world < 1:
@@ -97,26 +105,43 @@ class TuneTopology:
                 f"slice_size must be >= 1 or None; got {self.slice_size}")
         if self.fsdp is not None and self.fsdp < 1:
             raise ValueError(f"fsdp must be >= 1 or None; got {self.fsdp}")
+        if self.region_size is not None and self.slice_size is None:
+            raise ValueError(
+                "region_size requires slice_size — the WAN tier nests "
+                "outside the slice tier")
+        if self.region_size is not None:
+            # mirror core.Topology's tier-nesting contract at parse time,
+            # so an impossible spec dies on the CLI, not mid-funnel
+            if (self.region_size < 1
+                    or self.region_size % self.slice_size != 0):
+                raise ValueError(
+                    f"region_size {self.region_size} must be a whole "
+                    f"multiple of slice_size {self.slice_size} — regions "
+                    "are made of whole slices")
 
     @classmethod
     def parse(cls, text: str) -> "TuneTopology":
         parts = [p.strip() for p in str(text).split(",") if p.strip()]
-        if not parts or len(parts) > 2:
+        if not parts or len(parts) > 3:
             raise ValueError(
-                f"topology spec {text!r} is not 'W', 'W,slice_size', or "
-                "'DPxFSDP[,slice_size]'")
+                f"topology spec {text!r} is not 'W', "
+                "'W,slice_size[,region_size]', or "
+                "'DPxFSDP[,slice_size[,region_size]]'")
         head = parts[0].lower().replace("×", "x")
         if "x" in head:
             dp_s, fsdp_s = head.split("x", 1)
             world, fsdp = int(dp_s), int(fsdp_s)
         else:
             world, fsdp = int(head), None
-        slice_size = int(parts[1]) if len(parts) == 2 else None
-        return cls(world=world, slice_size=slice_size, fsdp=fsdp)
+        slice_size = int(parts[1]) if len(parts) >= 2 else None
+        region_size = int(parts[2]) if len(parts) == 3 else None
+        return cls(world=world, slice_size=slice_size, fsdp=fsdp,
+                   region_size=region_size)
 
     def core_topology(self):
         from grace_tpu.core import Topology
-        return Topology(slice_size=self.slice_size)
+        return Topology(slice_size=self.slice_size,
+                        region_size=self.region_size)
 
     @property
     def devices(self) -> int:
@@ -129,7 +154,9 @@ class TuneTopology:
              else f"W{self.world}x{self.fsdp}")
         if self.slice_size is None:
             return w
-        return f"{w}/slice{self.slice_size}"
+        if self.region_size is None:
+            return f"{w}/slice{self.slice_size}"
+        return f"{w}/slice{self.slice_size}/region{self.region_size}"
 
 
 def dense_bytes(model_structs) -> int:
@@ -165,7 +192,7 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
     from grace_tpu.comm import Allreduce
     from grace_tpu.utils import wire_report
 
-    ici_bw, dcn_bw, _ = projection_constants()
+    ici_bw, dcn_bw, wan_bw, _ = projection_constants()
     dense_step_s = base_step_s if dense_step_s is None else dense_step_s
     rep = wire_report(grace.compressor, model_structs)
     n = n_elements(model_structs)
@@ -181,23 +208,22 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
     # within one slice and DCN the moment the axis crosses slices.
     import jax
 
-    from grace_tpu.core import LinkBytes
     from grace_tpu.transform import fusion_payload_structs
 
     n_calls = sum(count for _, count in fusion_payload_structs(
         jax.tree_util.tree_leaves(model_structs), grace.fusion))
     neg_b = n_calls * int(grace.compressor.negotiation_nbytes(spec.world))
     if neg_b:
-        if topo.crosses_dcn(spec.world):
-            link = LinkBytes(ici=link.ici, dcn=link.dcn + neg_b)
-        else:
-            link = LinkBytes(ici=link.ici + neg_b, dcn=link.dcn)
+        # Flat full-axis collective: priced on the worst tier the axis
+        # spans — the same flat_tier rule the telemetry fold uses.
+        tier = topo.flat_tier(spec.world)
+        link = link._replace(**{tier: getattr(link, tier) + neg_b})
     dense_link = Allreduce(
         axis_name=grace.communicator.axis_name).recv_link_bytes(
             dense_b, n, spec.world, topology=topo)
 
     def wire_s(lb):
-        return lb.ici / ici_bw + lb.dcn / dcn_bw
+        return lb.ici / ici_bw + lb.dcn / dcn_bw + lb.wan / wan_bw
 
     step_s = base_step_s + wire_s(link)
     d_step_s = dense_step_s + wire_s(dense_link)
@@ -225,9 +251,11 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
         "negotiation_bytes": int(neg_b),
         "ici_bytes": int(link.ici),
         "dcn_bytes": int(link.dcn),
+        "wan_bytes": int(link.wan),
         "wire_ms": round(wire_s(link) * 1e3, 9),
         "dense_ici_bytes": int(dense_link.ici),
         "dense_dcn_bytes": int(dense_link.dcn),
+        "dense_wan_bytes": int(dense_link.wan),
         "dense_wire_ms": round(wire_s(dense_link) * 1e3, 9),
         "projected_step_ms": round(step_s * 1e3, 9),
         "dense_projected_step_ms": round(d_step_s * 1e3, 9),
@@ -248,12 +276,12 @@ def adapt_rung_prices(grace, model_structs, spec: TuneTopology, *,
     from grace_tpu.comm import Allreduce
     from grace_tpu.utils import wire_report
 
-    ici_bw, dcn_bw, _ = projection_constants()
+    ici_bw, dcn_bw, wan_bw, _ = projection_constants()
     n = n_elements(model_structs)
     topo = spec.core_topology()
 
     def wire_s(lb):
-        return lb.ici / ici_bw + lb.dcn / dcn_bw
+        return lb.ici / ici_bw + lb.dcn / dcn_bw + lb.wan / wan_bw
 
     out = []
     esc = getattr(grace, "escape", None)
@@ -267,6 +295,7 @@ def adapt_rung_prices(grace, model_structs, spec: TuneTopology, *,
                           else "dense"),
                 "payload_bytes": int(esc_b),
                 "ici_bytes": int(link0.ici), "dcn_bytes": int(link0.dcn),
+                "wan_bytes": int(link0.wan),
                 "projected_step_ms": round(
                     (base_step_s + wire_s(link0)) * 1e3, 9)})
     for ri, comp in enumerate(grace.adapt.ladder, start=1):
@@ -280,6 +309,7 @@ def adapt_rung_prices(grace, model_structs, spec: TuneTopology, *,
                     "negotiation_bytes": neg,
                     "ici_bytes": int(link.ici),
                     "dcn_bytes": int(link.dcn),
+                    "wan_bytes": int(link.wan),
                     "projected_step_ms": round(
                         (base_step_s + wire_s(link)) * 1e3, 9)})
     return out

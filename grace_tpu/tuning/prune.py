@@ -303,6 +303,7 @@ def static_prune(candidates: List[Candidate], spec: TuneTopology,
 
     return {
         "topology": {"world": spec.world, "slice_size": spec.slice_size,
+                     "region_size": spec.region_size,
                      "label": spec.label},
         "funnel": funnel,
         "ranking": [{"candidate": r["candidate"],
@@ -312,6 +313,7 @@ def static_prune(candidates: List[Candidate], spec: TuneTopology,
                          r["predicted"]["predicted_speedup_vs_dense"],
                      "ici_bytes": r["predicted"]["ici_bytes"],
                      "dcn_bytes": r["predicted"]["dcn_bytes"],
+                     "wan_bytes": r["predicted"]["wan_bytes"],
                      "verdict": r["verdict"]}
                     for r in ranked],
         "shortlist": shortlist,

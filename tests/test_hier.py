@@ -518,7 +518,9 @@ def test_wire_pass_counts_grouped_collectives_by_group_size():
         "communicator": "hier", "slice_size": 4, "fusion": "flat"}})
     t = trace_update(grace, name="hier", meta={"grace": grace})
     topo = Topology(slice_size=4)
-    ici, dcn = count_recv_link_bytes(t.body, t.axis_name, t.world, topo)
+    ici, dcn, wan = count_recv_link_bytes(t.body, t.axis_name, t.world,
+                                          topo)
+    assert wan == 0  # 2-tier topology: nothing crosses a region
     _, comp_b, n_elems = fusion_payload_nbytes(
         grace.compressor, list(default_param_structs().values()), "flat")
     lb = grace.communicator.recv_link_bytes(comp_b, n_elems, t.world,

@@ -1,0 +1,702 @@
+"""graft-region: three-tier WAN topology (core N-tier link model, the
+three-level hierarchical schedule, region-loss elasticity — ISSUE 16).
+
+The properties pinned here are the region track's acceptance criteria:
+
+* the N-tier ``LinkBytes`` stays an exact alias of the committed 2-tier
+  constructor and pins the W=0/W=1 edges to zero on EVERY tier for every
+  communicator (vote routes included);
+* the three-level schedule's wire split follows the documented formula
+  (ICI ``2p(S−1)/S``, DCN ``(Kr−1)p/S``, WAN ``(R−1)p/S``) and degrades
+  tier by tier when the schedule's groupings stop nesting in the physical
+  ones;
+* a single-region fleet IS the two-tier fleet: model split and mesh
+  output both collapse bitwise — no tolerance, no vestigial WAN leg;
+* exact/homomorphic/sketch payloads (none/fp16/randomk/homoqsgd/
+  countsketch) cross the WAN boundary exactly-summable — bit-identical to
+  the flat ring on integer-valued gradients at every (slice, region)
+  split — while requant codecs re-encode the region partial ONCE through
+  the aggressive per-level ``wan_compressor`` (whose gates reject the
+  combinations that would silently lose the zero-requant property);
+* ``Topology.shrink``/``plan_resize`` resolve losses at the finest
+  violated granularity (region → slice → rank), and ``Topology.detect``
+  gives ``region_index`` the same hardening ``slice_index`` has;
+* ``ElasticController`` treats a region-wide skew episode as ONE
+  drain→resize transition (``region_scope`` quorum) and bounds the drain
+  checkpoint behind a backoff watchdog (``elastic_drain_timeout``);
+* telemetry's ``wire_bytes_ici + wire_bytes_dcn + wire_bytes_wan ==
+  wire_bytes`` identity survives the fallback flip and the flat-collective
+  folds (watch gather, shared-scale negotiation, adapt signal), all of
+  which land on the WAN leg when the axis spans regions.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from grace_tpu import comm, grace_from_params
+from grace_tpu import compressors as C
+from grace_tpu.core import LinkBytes, Topology
+from grace_tpu.memories import NoneMemory
+from grace_tpu.parallel import shard_map
+from grace_tpu.resilience import ElasticController, plan_resize
+from grace_tpu.telemetry import TelemetryReader
+from grace_tpu.train import init_train_state, make_train_step
+from grace_tpu.transform import set_fallback_flag
+
+W = 8
+
+pytestmark = pytest.mark.region
+
+# 2 regions x 2 slices x 2 ranks on the 8-device mesh: the smallest layout
+# where all three tiers carry traffic (same layout as chaos_smoke --region
+# and the registered *-hier3 configs).
+TOPO3 = Topology(slice_size=2, region_size=4)
+
+BATCH, DIM, CLASSES = 64, 20, 4
+
+
+def run_step(mesh, communicator, compressor, memory, per_rank, seed=0):
+    """Full pipeline step per rank on ``mesh``; returns rank 0's output."""
+    w = len(mesh.devices)
+
+    def body(x):
+        x = x[0]
+        ms = memory.init_state(x)
+        cs = compressor.init_state(x)
+        out, ms, _ = communicator.step(x, ms, cs, memory, compressor,
+                                       jax.random.key(seed))
+        return out[None]
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("data"),
+                   out_specs=P("data"), check_vma=False)
+    assert per_rank.shape[0] == w
+    return np.asarray(fn(per_rank)[0])
+
+
+# ---------------------------------------------------------------------------
+# the N-tier LinkBytes value itself
+# ---------------------------------------------------------------------------
+
+def test_linkbytes_two_tier_constructor_is_exact_alias():
+    """Every pre-region call site builds LinkBytes(ici, dcn): that value
+    must be indistinguishable from the 3-tier one with wan=0 — committed
+    evidence (BENCH/TUNE/LINT_LAST) stays bit-identical."""
+    two = LinkBytes(ici=3, dcn=4)
+    three = LinkBytes(ici=3, dcn=4, wan=0)
+    assert two == three
+    assert two.wan == 0
+    assert two.total == 7
+    assert two.tiers == (3, 4, 0)
+    assert LinkBytes(1, 2, 5).total == 8
+    assert LinkBytes(1, 2, 5).tiers == (1, 2, 5)
+
+
+ALL_COMMS = [comm.Allreduce(), comm.Allgather(), comm.RingAllreduce(),
+             comm.TwoShotAllreduce(), comm.ReduceScatterAllreduce(),
+             comm.SignAllreduce(), comm.Broadcast(),
+             comm.HierarchicalAllreduce(slice_size=2),
+             comm.HierarchicalAllreduce(slice_size=2, region_size=4)]
+
+
+@pytest.mark.parametrize("world", [0, 1], ids=["w0", "w1"])
+@pytest.mark.parametrize("vote", [False, True], ids=["payload", "vote"])
+@pytest.mark.parametrize("c", ALL_COMMS, ids=lambda c: type(c).__name__)
+def test_recv_link_bytes_degenerate_worlds_are_zero_on_every_tier(
+        c, vote, world):
+    """W=0/W=1 edge pin: no peer, no wire — zero on EVERY tier, under a
+    topology that would otherwise claim the axis spans regions. A formula
+    that goes negative (S−1 terms) or prices a self-exchange is a wire
+    model bug the auditor would inherit."""
+    lb = c.recv_link_bytes(1000, 250, world, topology=TOPO3, vote=vote)
+    assert lb == LinkBytes(ici=0, dcn=0, wan=0)
+    assert c.recv_wire_bytes(1000, 250, world, vote=vote) == 0
+
+
+# ---------------------------------------------------------------------------
+# the three-level schedule's wire split and its degradation ladder
+# ---------------------------------------------------------------------------
+
+def test_hier3_split_formula_and_sum_identity():
+    """The documented three-leg formula at W=8 / slice 2 / region 4:
+    S=2, Kr=2 slices per region, R=2 regions."""
+    p = 1600
+    h = comm.HierarchicalAllreduce(slice_size=2, region_size=4)
+    lb = h.recv_link_bytes(p, 400, W, topology=TOPO3)
+    s, kr, r = 2, 2, 2
+    assert lb.ici == 2 * p * (s - 1) // s        # intra-slice ring legs
+    assert lb.dcn == (kr - 1) * p // s           # cross-slice partials
+    assert lb.wan == (r - 1) * p // s            # cross-region partials
+    assert lb.total == h.recv_wire_bytes(p, 400, W)
+    assert lb.ici > 0 and lb.dcn > 0 and lb.wan > 0
+
+
+def test_flat_schedule_prices_at_worst_tier():
+    """A flat collective's whole bill lands on the slowest boundary the
+    axis spans (Topology.flat_tier): WAN across regions, DCN across
+    slices, ICI inside one."""
+    p = 1600
+    ring = comm.RingAllreduce()
+    assert TOPO3.flat_tier(W) == "wan"
+    lb = ring.recv_link_bytes(p, 400, W, topology=TOPO3)
+    assert (lb.ici, lb.dcn) == (0, 0) and lb.wan == lb.total > 0
+    assert TOPO3.flat_tier(4) == "dcn"           # one region, two slices
+    lb4 = ring.recv_link_bytes(p, 400, 4, topology=TOPO3)
+    assert (lb4.ici, lb4.wan) == (0, 0) and lb4.dcn > 0
+    assert TOPO3.flat_tier(2) == "ici"           # inside one slice
+    lb2 = ring.recv_link_bytes(p, 400, 2, topology=TOPO3)
+    assert (lb2.dcn, lb2.wan) == (0, 0) and lb2.ici > 0
+
+
+def test_two_level_schedule_on_three_tier_fleet_pays_wan_for_cross():
+    """Degradation ladder: a two-level schedule whose cross-slice groups
+    span regions puts the WHOLE cross bill on WAN — some group member's
+    incoming link is a region boundary."""
+    p = 1600
+    h2 = comm.HierarchicalAllreduce(slice_size=2)
+    lb = h2.recv_link_bytes(p, 400, W, topology=TOPO3)
+    h2_flat = h2.recv_link_bytes(p, 400, W,
+                                 topology=Topology(slice_size=2))
+    assert lb.ici == h2_flat.ici                 # intra legs still ICI
+    assert lb.dcn == 0
+    assert lb.wan == h2_flat.dcn                 # cross bill, one tier down
+    assert lb.total == h2_flat.total             # the scalar never moves
+
+
+def test_single_region_collapses_to_two_tier_bitwise():
+    """One region == no WAN tier. Model: the 3-tier split equals the
+    committed 2-tier split exactly. Mesh: the schedules are identical, so
+    the outputs are bit-identical even on float data."""
+    p = 1600
+    h3 = comm.HierarchicalAllreduce(slice_size=2, region_size=8)
+    h2 = comm.HierarchicalAllreduce(slice_size=2)
+    t3 = Topology(slice_size=2, region_size=8)
+    t2 = Topology(slice_size=2)
+    lb3 = h3.recv_link_bytes(p, 400, W, topology=t3)
+    lb2 = h2.recv_link_bytes(p, 400, W, topology=t2)
+    assert lb3 == lb2 and lb3.wan == 0
+    assert not t3.crosses_wan(W)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(W, 41)).astype(np.float32))
+    mesh = Mesh(np.array(jax.devices()[:W]), ("data",))
+    out3 = run_step(mesh, h3, C.TopKCompressor(compress_ratio=0.3),
+                    NoneMemory(), x)
+    out2 = run_step(mesh, h2, C.TopKCompressor(compress_ratio=0.3),
+                    NoneMemory(), x)
+    np.testing.assert_array_equal(out3, out2)
+
+
+# ---------------------------------------------------------------------------
+# exact summation across the WAN boundary
+# ---------------------------------------------------------------------------
+
+REGION_SPLITS = [(1, 2), (1, 4), (2, 4), (2, 8), (4, 8), (2, 2)]
+
+# Every split is covered, but only the canonical s2r4 split (both
+# boundaries inside the mesh) runs in tier-1 — each traced step costs
+# seconds of shard_map compile, so the full matrix is `slow`.
+_FAST_SPLIT = (2, 4)
+
+
+def _split_params(splits):
+    return [pytest.param(sp, id=f"s{sp[0]}r{sp[1]}",
+                         marks=() if sp == _FAST_SPLIT
+                         else pytest.mark.slow)
+            for sp in splits]
+
+
+@pytest.mark.parametrize("comp", [C.NoneCompressor(), C.FP16Compressor(),
+                                  C.HomoQSGDCompressor(quantum_num=7)],
+                         ids=["none", "fp16", "homoqsgd"])
+@pytest.mark.parametrize("split", _split_params(REGION_SPLITS))
+def test_hier3_bit_identical_to_flat_ring_on_integer_grads(rng, comp,
+                                                           split):
+    """ISSUE 16 acceptance: the three-level schedule — intra-slice ring,
+    cross-slice gather-sum, cross-region gather-sum — is BIT-identical to
+    the flat ring for selection-free exact payloads at every (slice,
+    region) split. Integer-valued gradients make every partial sum exactly
+    representable (f32, fp16 AND homoqsgd's shared-scale integer levels),
+    so a wrong region grouping, a dropped cross-region partial, or a
+    requant sneaking into the WAN leg shows up as an integer-sized
+    error."""
+    s, r = split
+    x = rng.integers(-7, 8, size=(W, 37)).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:W]), ("data",))
+    ref = run_step(mesh, comm.RingAllreduce(), comp, NoneMemory(),
+                   jnp.asarray(x))
+    out = run_step(mesh,
+                   comm.HierarchicalAllreduce(slice_size=s, region_size=r),
+                   comp, NoneMemory(), jnp.asarray(x))
+    np.testing.assert_array_equal(out, ref)
+
+
+THREE_TIER_SPLITS = [(2, 4), (2, 8), (4, 8), (2, 2)]
+
+
+# The selection codecs (randomk, countsketch) are the ones the flat-ring
+# comparison above cannot cover, so they are the tier-1 representatives
+# here; the exact/homomorphic codecs already have a fast bit-identity pin
+# vs the ring and run this matrix only in the full (slow) suite.
+@pytest.mark.parametrize(
+    "comp",
+    [pytest.param(C.NoneCompressor(), id="none", marks=pytest.mark.slow),
+     pytest.param(C.FP16Compressor(), id="fp16", marks=pytest.mark.slow),
+     pytest.param(C.RandomKCompressor(compress_ratio=0.5), id="randomk"),
+     pytest.param(C.HomoQSGDCompressor(quantum_num=7), id="homoqsgd",
+                  marks=pytest.mark.slow),
+     pytest.param(C.CountSketchCompressor(compress_ratio=0.5),
+                  id="countsketch")])
+@pytest.mark.parametrize("split", _split_params(THREE_TIER_SPLITS))
+def test_region_tier_adds_zero_loss_vs_two_tier(rng, comp, split):
+    """The WAN level costs NOTHING in accuracy for every payload algebra:
+    at the same slice width, the three-level schedule is bit-identical to
+    the two-level one on integer-valued gradients — splitting the
+    cross-slice sum into a DCN stage and a WAN stage only reassociates an
+    exact payload-space sum. (Selection codecs — randomk's shard-folded
+    keys, countsketch's hash stream — shard identically at equal S, so
+    this holds where the flat-ring comparison cannot: the flat ring
+    shards W ways, not S ways.)"""
+    s, r = split
+    x = rng.integers(-7, 8, size=(W, 37)).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:W]), ("data",))
+    two = run_step(mesh, comm.HierarchicalAllreduce(slice_size=s), comp,
+                   NoneMemory(), jnp.asarray(x), seed=5)
+    three = run_step(mesh,
+                     comm.HierarchicalAllreduce(slice_size=s,
+                                                region_size=r),
+                     comp, NoneMemory(), jnp.asarray(x), seed=5)
+    np.testing.assert_array_equal(three, two)
+
+
+def test_wan_compressor_gates_and_wan_leg_width(rng):
+    """The aggressive per-level WAN codec: only legal over a requant base
+    (exact payloads must keep their zero-requant WAN sum), must itself be
+    a hop-requant codec, needs a region tier to encode for — and when
+    armed, the WAN leg is priced at the WAN codec's own payload width."""
+    wan = C.TopKCompressor(compress_ratio=0.05)
+    mesh = Mesh(np.array(jax.devices()[:W]), ("data",))
+    x = jnp.asarray(rng.normal(size=(W, 16)).astype(np.float32))
+    with pytest.raises(TypeError, match="exactly-summable"):
+        run_step(mesh,
+                 comm.HierarchicalAllreduce(slice_size=2, region_size=4,
+                                            wan_compressor=wan),
+                 C.FP16Compressor(), NoneMemory(), x)
+    with pytest.raises(TypeError, match="supports_hop_requant"):
+        run_step(mesh,
+                 comm.HierarchicalAllreduce(
+                     slice_size=2, region_size=4,
+                     wan_compressor=C.FP16Compressor()),
+                 C.TopKCompressor(compress_ratio=0.3), NoneMemory(), x)
+    with pytest.raises(ValueError, match="region_size"):
+        comm.HierarchicalAllreduce(slice_size=2, wan_compressor=wan)
+
+    base = comm.HierarchicalAllreduce(slice_size=2, region_size=4)
+    armed = comm.HierarchicalAllreduce(slice_size=2, region_size=4,
+                                       wan_compressor=wan)
+    p, n = 1600, 400
+    lb0 = base.recv_link_bytes(p, n, W, topology=TOPO3)
+    lb1 = armed.recv_link_bytes(p, n, W, topology=TOPO3)
+    # intra and cross-slice legs are untouched; the WAN leg shrinks to
+    # the aggressive codec's width (5% topk of a 200-element f32 shard).
+    assert (lb1.ici, lb1.dcn) == (lb0.ici, lb0.dcn)
+    assert 0 < lb1.wan < lb0.wan
+    assert lb1.total == armed.recv_wire_bytes(p, n, W)
+    # shrunk to a region-less topology drops the WAN codec with the tier
+    assert armed.shrunk(Topology(slice_size=2)).wan_compressor is None
+    assert armed.shrunk(TOPO3).wan_compressor is wan
+
+
+@pytest.mark.slow
+def test_wan_compressor_step_converges_on_mesh(rng):
+    """The armed WAN requant path runs end to end on the 3-tier mesh and
+    stays a faithful (if aggressive) estimate of the dense mean: the
+    region boundary pays ONE re-encode, not R−1."""
+    x = rng.normal(size=(W, 64)).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:W]), ("data",))
+    out = run_step(
+        mesh,
+        comm.HierarchicalAllreduce(
+            slice_size=2, region_size=4,
+            wan_compressor=C.TopKCompressor(compress_ratio=0.5)),
+        C.TopKCompressor(compress_ratio=0.5), NoneMemory(),
+        jnp.asarray(x))
+    ref = x.mean(0)
+    assert np.isfinite(out).all()
+    nz = out != 0
+    assert nz.any()
+    # surviving lanes carry twice-top-k'd PARTIAL sums (the intra-slice
+    # selection runs before the boundary), so the pin is bounded error +
+    # strong alignment with the dense mean, not bit-equality.
+    rel = np.linalg.norm(out - ref) / np.linalg.norm(ref)
+    assert rel < 0.75
+    cos = float(out @ ref) / (np.linalg.norm(out) * np.linalg.norm(ref))
+    assert cos > 0.7
+
+
+# ---------------------------------------------------------------------------
+# shrink / plan_resize granularity: region -> slice -> rank
+# ---------------------------------------------------------------------------
+
+def test_shrink_granularity_matrix():
+    """The finest violated level decides what survives (ROADMAP item 4):
+    whole regions keep the full 3-tier layout (until one region remains),
+    whole slices keep the slice tier only, partial slices keep nothing."""
+    t = Topology(slice_size=2, region_size=4)
+    # whole region lost, >= 2 regions remain: full 3-tier survives
+    assert t.shrink(16, range(12, 16)) == (t, 12)
+    # two whole regions lost of four: still 3-tier
+    assert t.shrink(16, range(4, 12)) == (t, 8)
+    # three whole regions lost: one region remains -> WAN tier is vacuous
+    assert t.shrink(16, range(4, 16)) == (Topology(slice_size=2), 4)
+    assert t.shrink(8, range(4, 8)) == (Topology(slice_size=2), 4)
+    # whole slice lost (not a whole region): slice tier survives alone
+    assert t.shrink(16, (2, 3)) == (Topology(slice_size=2), 14)
+    # partial slice lost: flat layout
+    assert t.shrink(16, (5,)) == (Topology(), 15)
+    # nothing lost: identity
+    assert t.shrink(16, ()) == (t, 16)
+
+
+def test_plan_resize_whole_regions_flag():
+    """ResizePlan surfaces region granularity the way it surfaces slice
+    granularity — the elastic_resize event and chaos_smoke assert on it."""
+    p = plan_resize(W, (4, 5, 6, 7), TOPO3)
+    assert p.whole_regions and p.whole_slices
+    assert p.topology == Topology(slice_size=2)   # one region remains
+    assert p.new_world == 4 and p.survivors == (0, 1, 2, 3)
+    p = plan_resize(W, (2, 3), TOPO3)             # a slice, not a region
+    assert p.whole_slices and not p.whole_regions
+    assert p.topology == Topology(slice_size=2)
+    p = plan_resize(W, (1,), TOPO3)               # a rank, not a slice
+    assert not p.whole_slices and not p.whole_regions
+    assert p.topology == Topology()
+    p = plan_resize(W, (), TOPO3)                 # no loss: 3-tier intact
+    assert not p.whole_regions and p.topology == TOPO3
+
+
+# ---------------------------------------------------------------------------
+# Topology.detect: region_index gets slice_index's hardening, never less
+# ---------------------------------------------------------------------------
+
+class _Dev:
+    def __init__(self, slice_index=None, region_index=None):
+        if slice_index is not None:
+            self.slice_index = slice_index
+        if region_index is not None:
+            self.region_index = region_index
+
+
+def test_detect_reads_region_index_like_slice_index():
+    devs = [_Dev(slice_index=i // 2, region_index=i // 4) for i in range(8)]
+    assert Topology.detect(devs) == Topology(slice_size=2, region_size=4)
+
+
+def test_detect_single_region_is_no_region_tier():
+    devs = [_Dev(slice_index=i // 2, region_index=0) for i in range(8)]
+    assert Topology.detect(devs) == Topology(slice_size=2)
+
+
+def test_detect_rejects_partial_region_exposure():
+    devs = [_Dev(slice_index=i // 2,
+                 region_index=(i // 4 if i < 4 else None))
+            for i in range(8)]
+    with pytest.raises(ValueError, match="region_index"):
+        Topology.detect(devs)
+
+
+def test_detect_rejects_uneven_regions():
+    sizes = [5, 3]
+    devs = []
+    for rho, n in enumerate(sizes):
+        devs += [_Dev(slice_index=len(devs) + i, region_index=rho)
+                 for i in range(n)]
+    with pytest.raises(ValueError, match="uneven"):
+        Topology.detect(devs)
+
+
+def test_detect_rejects_region_without_slice_tier():
+    devs = [_Dev(region_index=i // 4) for i in range(8)]
+    with pytest.raises(ValueError, match="region tier without a slice"):
+        Topology.detect(devs)
+
+
+def test_detect_rejects_slice_straddling_region_boundary():
+    # slices of 3 inside regions of 4: region width is not a multiple of
+    # the slice width — the contiguous-block descriptor cannot express it.
+    devs = [_Dev(slice_index=i // 3, region_index=i // 4)
+            for i in range(12)]
+    with pytest.raises(ValueError, match="multiple of the slice"):
+        Topology.detect(devs)
+
+
+# ---------------------------------------------------------------------------
+# ElasticController: region-wide episodes are ONE transition; bounded drain
+# ---------------------------------------------------------------------------
+
+@pytest.mark.elastic
+def test_region_scope_quorum():
+    """region_scope widens a flagged rank to its whole region exactly when
+    region_quorum of the region's ranks carry skew episodes."""
+    ctl = ElasticController(anomaly_threshold=1, topology=TOPO3,
+                            region_quorum=0.5)
+    ctl.episodes = {4: 1}
+    assert ctl.region_scope(4) == (4,)            # 1 of 4 hot: below quorum
+    ctl.episodes = {4: 1, 6: 2}
+    assert ctl.region_scope(4) == (4, 5, 6, 7)    # 2 of 4 hot: region-wide
+    assert ctl.region_scope(6) == (4, 5, 6, 7)
+    assert ctl.region_scope(0) == (0,)            # the healthy region
+    strict = ElasticController(anomaly_threshold=1, topology=TOPO3,
+                               region_quorum=1.0)
+    strict.episodes = {4: 1, 5: 1, 6: 1}
+    assert strict.region_scope(4) == (4,)         # 3 of 4 < full quorum
+    strict.episodes = {4: 1, 5: 1, 6: 1, 7: 1}
+    assert strict.region_scope(4) == (4, 5, 6, 7)
+    # no region layout: scope is always the rank itself
+    flat = ElasticController(anomaly_threshold=1)
+    flat.episodes = {4: 9}
+    assert flat.region_scope(4) == (4,)
+
+
+@pytest.mark.elastic
+def test_region_drain_is_one_transition():
+    """Draining with a region scope marks every member drained, so later
+    threshold crossings inside the same region are absorbed — one failing
+    domain, one drain event."""
+    ctl = ElasticController(anomaly_threshold=1, topology=TOPO3,
+                            region_quorum=0.5)
+    skew = [{"kind": "skew", "metric": "compression_error", "rank": r}
+            for r in (4, 6)]
+    assert ctl.observe(0, skew[:1]) == 4
+    rec = ctl.drain(0, state=None, rank=4, scope=(4, 5, 6, 7))
+    assert rec["event"] == "elastic_drain"
+    assert rec["scope"] == [4, 5, 6, 7]
+    assert rec["drain_timeouts"] == 0
+    assert not rec["checkpointed"]                # no checkpointer armed
+    assert ctl.drained_ranks == {4, 5, 6, 7}
+    # rank 6 crosses the threshold next — absorbed, no second transition
+    assert ctl.observe(1, skew[1:]) is None
+    assert [e["event"] for e in ctl.events] == ["elastic_drain"]
+
+
+class _StallingCheckpointer:
+    """A wedged checkpoint backend: save returns, wait never does."""
+
+    def __init__(self, stall_s=30.0):
+        self.stall_s = stall_s
+        self.saves = 0
+
+    def save(self, step, state, force=True, good=True):
+        self.saves += 1
+
+    def wait(self):
+        time.sleep(self.stall_s)
+
+    def last_good_step(self):
+        return 7
+
+
+@pytest.mark.elastic
+def test_drain_timeout_backoff_and_proceed_with_last_known_good():
+    """A stalled checkpoint backend must not wedge the drain: each attempt
+    gets a bounded window, stalls emit elastic_drain_timeout with the
+    doubled-backoff window and the last known good step, and the drain
+    proceeds with checkpointed=False after the retry budget."""
+    ckpt = _StallingCheckpointer()
+    ctl = ElasticController(anomaly_threshold=1, checkpointer=ckpt,
+                            topology=TOPO3, drain_timeout_s=0.05,
+                            drain_retries=1)
+    t0 = time.perf_counter()
+    rec = ctl.drain(3, state=None, rank=4, scope=(4, 5, 6, 7))
+    assert time.perf_counter() - t0 < 5.0         # bounded, not 30 s
+    assert not rec["checkpointed"]
+    assert rec["drain_timeouts"] == 2             # first try + 1 retry
+    assert ckpt.saves == 2
+    touts = [e for e in ctl.events
+             if e["event"] == "elastic_drain_timeout"]
+    assert [e["attempt"] for e in touts] == [1, 2]
+    assert touts[0]["timeout_s"] == pytest.approx(0.05)
+    assert touts[1]["timeout_s"] == pytest.approx(0.10)  # doubled backoff
+    assert [e["retries_left"] for e in touts] == [1, 0]
+    assert all(e["last_good_step"] == 7 for e in touts)
+    # events stay ordered: the timeouts precede the drain record
+    assert [e["event"] for e in ctl.events] == [
+        "elastic_drain_timeout", "elastic_drain_timeout", "elastic_drain"]
+
+
+@pytest.mark.elastic
+def test_drain_timeout_validation_and_fast_path():
+    with pytest.raises(ValueError, match="drain_timeout_s"):
+        ElasticController(drain_timeout_s=0.0)
+    with pytest.raises(ValueError, match="drain_retries"):
+        ElasticController(drain_retries=-1)
+    with pytest.raises(ValueError, match="region_quorum"):
+        ElasticController(region_quorum=0.0)
+
+    class _Fast(_StallingCheckpointer):
+        def wait(self):
+            pass
+
+    ctl = ElasticController(checkpointer=_Fast(), drain_timeout_s=5.0)
+    rec = ctl.drain(0, state=None, rank=1)
+    assert rec["checkpointed"] and rec["drain_timeouts"] == 0
+    assert rec["scope"] == [1]                    # default scope: the rank
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the three-way split identity through every fold
+# ---------------------------------------------------------------------------
+
+def _problem(seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(DIM, CLASSES)).astype(np.float32)
+    x = rng.normal(size=(BATCH * W, DIM)).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    logits = x @ params["w"] + params["b"]
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _init_params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(
+                rng.normal(size=(DIM, CLASSES)).astype(np.float32) * 0.1),
+            "b": jnp.zeros((CLASSES,), jnp.float32)}
+
+
+def _run_rows(mesh, grace_params, schedule=("run", "run")):
+    """Rows from real steps; ``schedule`` entries: run | fallback."""
+    grc = grace_from_params(dict(grace_params))
+    tx = optax.chain(grc.transform(seed=0), optax.sgd(0.3))
+    state = init_train_state(_init_params(), tx, mesh)
+    step = make_train_step(_loss_fn, tx, mesh, donate=False)
+    batch = _problem()
+    for mode in schedule:
+        state = set_fallback_flag(state, mode == "fallback")
+        state, _ = step(state, batch)
+    rows = TelemetryReader(sink=None, every=100).flush(state)
+    assert rows
+    return grc, rows
+
+
+HIER3 = {"compressor": "topk", "compress_ratio": 0.3, "memory": "residual",
+         "communicator": "hier", "slice_size": 2, "region_size": 4,
+         "fusion": "flat", "telemetry": 16}
+
+
+@pytest.mark.telemetry
+def test_telemetry_three_way_split_identity_and_fallback_flip(mesh):
+    """hier3 rows carry a genuinely three-way split that sums to
+    wire_bytes and matches the config's own recv_link_bytes; during a
+    dense-fallback window the flat escape psum's bytes land ENTIRELY on
+    WAN (flat_tier of a region-spanning axis), and the identity holds
+    through the flip."""
+    grc, rows = _run_rows(mesh, dict(HIER3, escape="fp16"),
+                          schedule=("run", "fallback", "run"))
+    assert [r["fallback"] for r in rows] == [0, 1, 0]
+    for r in rows:
+        assert r["wire_bytes_ici"] + r["wire_bytes_dcn"] \
+            + r["wire_bytes_wan"] == r["wire_bytes"]
+    compressed = [r for r in rows if not r["fallback"]]
+    dense = [r for r in rows if r["fallback"]]
+    assert all(r["wire_bytes_ici"] > 0 and r["wire_bytes_dcn"] > 0
+               and r["wire_bytes_wan"] > 0 for r in compressed)
+    assert all(r["wire_bytes_ici"] == 0 and r["wire_bytes_dcn"] == 0
+               and r["wire_bytes_wan"] == r["wire_bytes"] > 0
+               for r in dense)
+    # the model the compressed rows must match bit-exactly
+    from grace_tpu.transform import fusion_payload_nbytes
+    _, comp_b, n_elems = fusion_payload_nbytes(
+        grc.compressor, jax.tree_util.tree_leaves(_init_params()), "flat")
+    lb = grc.communicator.recv_link_bytes(comp_b, n_elems, W,
+                                          topology=TOPO3)
+    for r in compressed:
+        assert (r["wire_bytes_ici"], r["wire_bytes_dcn"],
+                r["wire_bytes_wan"]) == (lb.ici, lb.dcn, lb.wan)
+
+
+@pytest.mark.telemetry
+@pytest.mark.watch
+def test_telemetry_watch_gather_folds_into_wan_leg(mesh):
+    """The watch health gather is a flat full-axis collective: on a
+    region-spanning axis its bytes fold into the WAN leg (and the scalar),
+    keeping the split identity exact on gather steps."""
+    grc, rows = _run_rows(
+        mesh, dict(HIER3, watch={"window": 1, "capacity": 8}))
+    # the reader interleaves watch summary rows with metric rows — only
+    # the metric rows carry the split (same filter as tests/test_hier.py)
+    gathered = [r for r in rows
+                if "wire_bytes_ici" in r and r.get("watch_bytes", 0) > 0]
+    assert gathered
+    from grace_tpu.transform import fusion_payload_nbytes
+    _, comp_b, n_elems = fusion_payload_nbytes(
+        grc.compressor, jax.tree_util.tree_leaves(_init_params()), "flat")
+    lb = grc.communicator.recv_link_bytes(comp_b, n_elems, W,
+                                          topology=TOPO3)
+    for r in gathered:
+        assert r["wire_bytes_ici"] + r["wire_bytes_dcn"] \
+            + r["wire_bytes_wan"] == r["wire_bytes"]
+        assert (r["wire_bytes_ici"], r["wire_bytes_dcn"]) == (lb.ici,
+                                                              lb.dcn)
+        assert r["wire_bytes_wan"] == lb.wan + r["watch_bytes"]
+
+
+@pytest.mark.telemetry
+@pytest.mark.homo
+def test_telemetry_negotiation_folds_into_wan_leg(mesh):
+    """The shared-scale negotiation pmax is a flat full-axis collective:
+    on a region-spanning axis its bytes land on the WAN leg — the split
+    identity survives the homomorphic codec's hoisted negotiation."""
+    grc, rows = _run_rows(
+        mesh, {"compressor": "homoqsgd", "quantum_num": 7,
+               "memory": "none", "communicator": "hier", "slice_size": 2,
+               "region_size": 4, "fusion": "flat", "telemetry": 16})
+    assert all(r["negotiation_bytes"] > 0 for r in rows)
+    from grace_tpu.transform import fusion_payload_nbytes
+    _, comp_b, n_elems = fusion_payload_nbytes(
+        grc.compressor, jax.tree_util.tree_leaves(_init_params()), "flat")
+    lb = grc.communicator.recv_link_bytes(comp_b, n_elems, W,
+                                          topology=TOPO3)
+    for r in rows:
+        assert r["wire_bytes_ici"] + r["wire_bytes_dcn"] \
+            + r["wire_bytes_wan"] == r["wire_bytes"]
+        assert (r["wire_bytes_ici"], r["wire_bytes_dcn"]) == (lb.ici,
+                                                              lb.dcn)
+        assert r["wire_bytes_wan"] == lb.wan + r["negotiation_bytes"]
+
+
+@pytest.mark.telemetry
+@pytest.mark.adapt
+def test_telemetry_adapt_signal_folds_into_wan_leg(mesh):
+    """graft-adapt's per-step signal reductions are flat full-axis
+    collectives too: priced on the WAN leg of a region-spanning axis, with
+    the identity exact at every rung (including the forced dense rung)."""
+    from grace_tpu.resilience.adapt import adapt_signal_bytes
+    grc, rows = _run_rows(
+        mesh, dict(HIER3, escape="fp16",
+                   adapt={"window": 4, "ladder": [{"compress_ratio": 0.1}],
+                          "tighten_error": 0.99, "tighten_peak": 0.999,
+                          "loosen_error": 0.25, "quiet_windows": 2,
+                          "hold_windows": 2}),
+        schedule=("run", "fallback", "run"))
+    sig = float(adapt_signal_bytes(W))
+    assert all(r["adapt_bytes"] == sig for r in rows)
+    for r in rows:
+        assert r["wire_bytes_ici"] + r["wire_bytes_dcn"] \
+            + r["wire_bytes_wan"] == r["wire_bytes"]
+    dense = [r for r in rows if r["fallback"]]
+    assert dense
+    # rung 0 is the flat escape psum: everything (payload + signal) on WAN
+    assert all(int(r["adapt_rung"]) == 0
+               and r["wire_bytes_ici"] == 0 and r["wire_bytes_dcn"] == 0
+               and r["wire_bytes_wan"] == r["wire_bytes"] for r in dense)

@@ -38,8 +38,8 @@ TOPK_TELEM = {"compressor": "topk", "compress_ratio": 0.3,
 REQUIRED = ("grad_norm", "update_norm", "residual_norm", "residual_max",
             "compression_error", "wire_bytes", "dense_bytes", "fallback",
             "audit_bytes", "wire_bytes_ici", "wire_bytes_dcn",
-            "watch_bytes", "negotiation_bytes", "adapt_rung",
-            "adapt_bytes")
+            "wire_bytes_wan", "watch_bytes", "negotiation_bytes",
+            "adapt_rung", "adapt_bytes")
 
 
 def _problem(seed=0):

@@ -95,6 +95,24 @@ convergence-floor verdict, per-world footprint checks) lands in
 ``--elastic-out`` (ELASTIC_LAST.json), rendered by evidence_summary.py;
 ``elastic_*`` events additionally stream into the telemetry JSONL.
 
+Region scenario (ISSUE 16): ``--region`` runs the cross-region failure
+lifecycle on the 8-device mesh laid out as 2 regions × 2 slices × 2 ranks
+(``Topology(slice_size=2, region_size=4)``, three-level hier exchange).
+Drift is seeded on one rank PER SLICE of the doomed region (guard-blind);
+graft-watch flags them, and once a quorum (``region_quorum=0.5``) of the
+region's ranks carries skew episodes the :class:`ElasticController`
+recognizes the region-wide episode (:meth:`region_scope`) and handles it
+as ONE drain → resize → rejoin transition — never ``region_size``
+independent rank losses. The kill takes the WHOLE region: an R→R−1
+WAN-level resize that collapses to the two-tier ``Topology(slice_size=2)``
+when a single region remains, resumes at W−4, then the region REJOINS at
+W with stale pre-departure params implanted on every lost rank and must
+pass the consensus-gated rejoin barrier (one region rejoin == one barrier
+repair event; replicas bit-identical after). The guard must stay silent
+throughout the healthy path, and the convergence floor is judged after
+the rejoin. Evidence lands in ``--region-out`` (REGION_LAST.json),
+rendered by evidence_summary.py.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py            # defaults
@@ -105,6 +123,7 @@ Usage::
     python tools/chaos_smoke.py --watch --watch-rank 3       # drift watch
     python tools/chaos_smoke.py --elastic                    # kill + rejoin
     python tools/chaos_smoke.py --elastic --hier --slice-size 4  # slice kill
+    python tools/chaos_smoke.py --region                     # region kill
 """
 
 from __future__ import annotations
@@ -214,6 +233,24 @@ def main(argv=None) -> int:
                          "--elastic; under --hier its whole slice is lost)")
     ap.add_argument("--elastic-out", default="ELASTIC_LAST.json",
                     help="evidence JSON path for --elastic ('' disables)")
+    ap.add_argument("--region", action="store_true",
+                    help="run the cross-region failure lifecycle (ISSUE "
+                         "16): three-tier mesh (2 regions × 2 slices × 2 "
+                         "ranks), drift on one rank per slice of the "
+                         "doomed region → watch flags them → the "
+                         "controller recognizes the region-wide episode "
+                         "(region_scope quorum) and drains ONCE → the "
+                         "whole region dies (R→R−1, topology collapses "
+                         "to two-tier) → resume at W−4 → the region "
+                         "rejoins at W behind the consensus barrier")
+    ap.add_argument("--region-size", type=int, default=4,
+                    help="ranks per region for --region (slices are half "
+                         "a region wide so all three tiers are exercised)")
+    ap.add_argument("--region-out", default="REGION_LAST.json",
+                    help="evidence JSON path for --region ('' disables)")
+    ap.add_argument("--drain-timeout", type=float, default=60.0,
+                    help="ElasticController drain watchdog seconds "
+                         "(--region; 0 disables the watchdog)")
     ap.add_argument("--floor", type=float, default=2.25,
                     help="convergence floor: the post-rejoin final loss "
                          "must be below this (10-class CE starts ~2.303)")
@@ -264,6 +301,8 @@ def main(argv=None) -> int:
         return _adapt_main(args)
     if args.elastic:
         return _elastic_main(args)
+    if args.region:
+        return _region_main(args)
     if args.fsdp:
         return _fsdp_main(args)
 
@@ -1340,6 +1379,345 @@ def _elastic_main(args) -> int:
             f.write("\n")
         os.replace(tmp, args.elastic_out)
         print(f"[chaos_smoke] elastic evidence: {args.elastic_out}")
+
+    if not np.isfinite(loss_c):
+        print("[chaos_smoke] FAIL: final loss non-finite after the rejoin",
+              file=sys.stderr)
+        return 1
+    if not floor_met:
+        print(f"[chaos_smoke] FAIL: final loss {loss_c:.4f} misses the "
+              f"convergence floor {args.floor}", file=sys.stderr)
+        return 1
+    if not (fp_down and fp_up):
+        print("[chaos_smoke] FAIL: re-sharded state does not match the "
+              "static footprint model", file=sys.stderr)
+        return 1
+    print("[chaos_smoke] OK")
+    return 0
+
+
+def _region_main(args) -> int:
+    """The --region lifecycle: drift inside one region → region-wide watch
+    signal → ONE drain → whole-region kill (R→R−1, topology collapses to
+    two-tier) → W−rz resume → region rejoin at W behind the consensus
+    barrier. Returns 0 only when every acceptance fact holds (see module
+    docstring)."""
+    import dataclasses
+    import json
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from grace_tpu import grace_from_params
+    from grace_tpu.checkpoint import Checkpointer
+    from grace_tpu.core import Topology
+    from grace_tpu.resilience import (ChaosCompressor, ConsensusConfig,
+                                      ElasticController, guarded_chain,
+                                      plan_resize, validate_resharded)
+    from grace_tpu.telemetry import JSONLSink, TelemetryReader
+    from grace_tpu.train import init_train_state, make_train_step
+    from grace_tpu.utils.logging import GuardMonitor, run_provenance
+    from grace_tpu.utils.metrics import guard_report
+    from grace_tpu.models import lenet
+    from grace_tpu.parallel import data_parallel_mesh
+
+    devices = jax.devices()
+    world = len(devices)
+    rz = args.region_size
+    if world % rz or rz < 2:
+        print(f"[chaos_smoke] --region: world {world} is not a multiple of "
+              f"region_size {rz} (>= 2 required)", file=sys.stderr)
+        return 1
+    if world // rz < 2:
+        print(f"[chaos_smoke] --region: need >= 2 regions; world {world} "
+              f"/ region_size {rz} leaves {world // rz}", file=sys.stderr)
+        return 1
+    # Slices half a region wide: every run exercises intra-slice ICI hops,
+    # same-region cross-slice DCN gathers AND cross-region WAN gathers.
+    s = max(1, rz // 2)
+    topo3 = Topology(slice_size=s, region_size=rz)
+    doomed_region = world // rz - 1              # the last region dies
+    lost = tuple(range(doomed_region * rz, (doomed_region + 1) * rz))
+    # One drifting rank per slice of the doomed region — enough for the
+    # 0.5 region quorum, few enough (2 of 8) that the fleet median the
+    # watch skew detector references stays healthy.
+    drift_ranks = tuple(doomed_region * rz + k * s
+                        for k in range(rz // s))
+    plan = plan_resize(world, lost, topo3)
+
+    steps_a = max(args.steps // 3, 2 * args.watch_window)
+    steps_b = max(args.steps // 4, 4)
+    steps_c = max(args.steps - steps_a - steps_b, 4)
+    consensus = ConsensusConfig(audit_every=args.audit_every)
+
+    def build(slice_size, region_size, drift=()):
+        """(grace, guarded tx) for one phase; rebuilding the transform is
+        the resize's single topology-invalidation point. slice/region
+        sizes come from the surviving Topology — the whole-region kill
+        hands back (slice_size, None) and the rejoin restores both."""
+        p = {"compressor": "topk", "compress_ratio": 0.3,
+             "memory": "residual", "communicator": "hier",
+             "fusion": "flat", "escape": "fp16", "consensus": consensus,
+             "telemetry": max(2 * args.telemetry_every, 16),
+             "watch": {"window": args.watch_window,
+                       "capacity": max(2 * args.telemetry_every
+                                       // args.watch_window, 8)}}
+        if slice_size:
+            p["slice_size"] = slice_size
+        if region_size:
+            p["region_size"] = region_size
+        grc = grace_from_params(p)
+        for dr in drift:
+            grc = dataclasses.replace(grc, compressor=ChaosCompressor(
+                inner=grc.compressor, drift_scale=args.drift_scale,
+                rank=dr, seed=args.seed + 3 + dr))
+        tx = guarded_chain(grc, optax.sgd(args.lr),
+                           fallback_after=args.fallback_after,
+                           fallback_steps=args.fallback_steps)
+        return grc, tx
+
+    def batches(w):
+        b = max(args.batch, w) // w * w
+        rng = np.random.default_rng(args.seed)
+        images = rng.normal(size=(4 * args.batch, 28, 28, 1)).astype(
+            np.float32)
+        labels = rng.integers(0, 10,
+                              size=(4 * args.batch,)).astype(np.int32)
+
+        def at(i):
+            lo = (i * b) % (len(images) - b + 1)
+            return (jnp.asarray(images[lo:lo + b]),
+                    jnp.asarray(labels[lo:lo + b]))
+        return at
+
+    def loss_fn(params, b):
+        x, y = b
+        logits, _ = lenet.apply(params, {}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    sink = None
+    reader = None
+    if args.telemetry_out:
+        sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+            data="synthetic", tool="chaos_smoke",
+            argv=" ".join(sys.argv[1:]), steps=args.steps,
+            region=True, region_size=rz, slice_size=s))
+        reader = TelemetryReader(sink, every=args.telemetry_every,
+                                 anomaly=True)
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="grace_region_")
+    ckpt = Checkpointer(ckpt_dir, max_to_keep=2)
+    controller = ElasticController(
+        consensus=consensus, checkpointer=ckpt, sink=sink,
+        anomaly_threshold=1, topology=topo3, region_quorum=0.5,
+        drain_timeout_s=args.drain_timeout or None, drain_retries=1)
+    monitor = GuardMonitor(sink=sink)
+
+    # ---- phase A: full world, one rank per slice of region R-1 drifting --
+    mesh_a = data_parallel_mesh(devices)
+    grc_a, tx_a = build(s, rz, drift=drift_ranks)
+    params, _ = lenet.init(jax.random.key(args.seed))
+    state = init_train_state(params, tx_a, mesh_a)
+    step = make_train_step(loss_fn, tx_a, mesh_a, donate=False,
+                           consensus=consensus)
+    at = batches(world)
+    t0 = time.perf_counter()
+    first_loss = None
+    drain_rank = None
+    drain_step = None
+    drain_scope = ()
+    seen_anomalies = 0
+
+    def try_drain(i, state):
+        """Widen each flagged rank to its region scope; drain only when
+        the episode is region-wide — the ONE-transition contract."""
+        nonlocal drain_rank, drain_step, drain_scope
+        for r in sorted(controller.episodes):
+            scope = controller.region_scope(r)
+            if len(scope) > 1:
+                controller.drain(i, state, r, scope=scope)
+                drain_rank, drain_step, drain_scope = r, i, scope
+                return True
+        return False
+
+    for i in range(steps_a):
+        state, loss = step(state, at(i))
+        if first_loss is None:
+            first_loss = float(loss)
+        monitor.update(i, guard_report(state))
+        if reader is not None and drain_rank is None:
+            reader.update(i, state)
+            anomalies = reader.monitor.anomalies
+            # Feed one record at a time: observe() returns early at a
+            # threshold crossing and would drop the rest of the batch.
+            for a in anomalies[seen_anomalies:]:
+                controller.observe(i, [a])
+            seen_anomalies = len(anomalies)
+            if try_drain(i, state):
+                break
+    if reader is not None and drain_rank is None:
+        reader.flush(state)
+        for a in reader.monitor.anomalies[seen_anomalies:]:
+            controller.observe(steps_a - 1, [a])
+        try_drain(steps_a - 1, state)
+    if reader is not None and drain_rank is None:
+        print(f"[chaos_smoke] FAIL: seeded drift on ranks "
+              f"{list(drift_ranks)} produced no region-wide drain signal "
+              f"in {steps_a} steps (episodes: {controller.episodes}) — "
+              "the early-warning channel is broken", file=sys.stderr)
+        return 1
+    if reader is None:
+        controller.episodes.update({r: 1 for r in drift_ranks})
+        controller.drain(steps_a - 1, state, drift_ranks[0],
+                         scope=controller.region_scope(drift_ranks[0]))
+        drain_rank, drain_step = drift_ranks[0], steps_a - 1
+        drain_scope = controller.region_scope(drift_ranks[0])
+    if tuple(sorted(drain_scope)) != lost:
+        print(f"[chaos_smoke] FAIL: drain scope {sorted(drain_scope)} is "
+              f"not the doomed region {list(lost)}", file=sys.stderr)
+        return 1
+    drain_events = [e for e in controller.events
+                    if e["event"] == "elastic_drain"]
+    if len(drain_events) != 1:
+        print(f"[chaos_smoke] FAIL: {len(drain_events)} drain transitions "
+              "for ONE region-wide episode", file=sys.stderr)
+        return 1
+    guard_a = guard_report(state)
+    if guard_a["notfinite_count"] != 0:
+        print("[chaos_smoke] FAIL: guard tripped during the drift phase — "
+              "the region faults are supposed to be guard-invisible",
+              file=sys.stderr)
+        return 1
+
+    # ---- kill the whole region, resize to the survivor world ------------
+    if not plan.whole_regions or plan.topology.region_size is not None:
+        print(f"[chaos_smoke] FAIL: plan {plan} did not recognize the "
+              "whole-region loss / single-region collapse", file=sys.stderr)
+        return 1
+    survivors = [devices[r] for r in plan.survivors]
+    mesh_b = data_parallel_mesh(survivors)
+    grc_b, tx_b = build(plan.topology.slice_size,
+                        plan.topology.region_size)
+    state_b, resize_down = controller.resize(
+        drain_step, state, tx_b, mesh_a, mesh_b, plan,
+        grace=grc_b, params=params)
+    print(f"[chaos_smoke] resize: W{plan.old_world} -> W{plan.new_world} "
+          f"(lost region {doomed_region}: ranks {list(plan.lost_ranks)}; "
+          f"topology -> slice_size {plan.topology.slice_size}, "
+          f"region_size {plan.topology.region_size}; whole_regions "
+          f"{plan.whole_regions}; footprint_matches "
+          f"{resize_down['footprint_matches']})")
+
+    # ---- phase B: the surviving region keeps training --------------------
+    step_b = make_train_step(loss_fn, tx_b, mesh_b, donate=False,
+                             consensus=consensus)
+    at_b = batches(plan.new_world)
+    loss_b = float("nan")
+    for i in range(steps_a, steps_a + steps_b):
+        state_b, loss_b = step_b(state_b, at_b(i))
+        if reader is not None:
+            reader.update(i, state_b)
+    if not np.isfinite(float(loss_b)):
+        print("[chaos_smoke] FAIL: loss went non-finite at the survivor "
+              f"world W{plan.new_world}", file=sys.stderr)
+        return 1
+
+    # ---- region rejoin at full world behind the consensus barrier --------
+    mesh_c = data_parallel_mesh(devices)
+    grc_c, tx_c = build(s, rz)
+    grow = plan_resize(world, (), topo3)   # no losses: fresh 3-tier plan
+    state_c, _ = controller.resize(
+        steps_a + steps_b, state_b, tx_c, mesh_b, mesh_c,
+        dataclasses.replace(grow, old_world=plan.new_world),
+        grace=grc_c, params=params)
+    from grace_tpu.resilience import implant_stale_replica
+    stale = ckpt.restore_last_good(state_c)
+    for r in plan.lost_ranks:
+        state_c = implant_stale_replica(state_c, r, stale.params)
+    state_c, barrier = controller.rejoin(steps_a + steps_b, state_c,
+                                         mesh_c)
+    print(f"[chaos_smoke] rejoin: barrier_repairs "
+          f"{barrier['barrier_repairs']} | replica_variants "
+          f"{barrier['replica_variants']} | fingerprint "
+          f"{barrier['fingerprint_bytes']} B")
+    # ONE region rejoin == ONE barrier repair event (the forced audit's
+    # masked broadcast repairs every stale replica of the region at once
+    # — region-granular, exactly like the drain).
+    if barrier["barrier_repairs"] != 1:
+        print(f"[chaos_smoke] FAIL: rejoin barrier repaired "
+              f"{barrier['barrier_repairs']} times for 1 region rejoin — "
+              "repairs must equal rejoins", file=sys.stderr)
+        return 1
+    if barrier["replica_variants"] != 1:
+        print("[chaos_smoke] FAIL: replicas not bit-identical after the "
+              "rejoin barrier", file=sys.stderr)
+        return 1
+
+    # ---- phase C: full three-tier world again, judge the floor -----------
+    step_c = make_train_step(loss_fn, tx_c, mesh_c, donate=False,
+                             consensus=consensus)
+    at_c = batches(world)
+    loss_c = float("nan")
+    for i in range(steps_a + steps_b, steps_a + steps_b + steps_c):
+        state_c, loss_c = step_c(state_c, at_c(i))
+        monitor.update(i, guard_report(state_c))
+        if reader is not None:
+            reader.update(i, state_c)
+    loss_c = float(loss_c)
+    dt = time.perf_counter() - t0
+    if reader is not None:
+        reader.flush(state_c)
+        reader.close()
+    ckpt.close()
+
+    fp_down = bool(resize_down["footprint_matches"])
+    fp_up = validate_resharded(state_c, grc_c, params, world)["matches"]
+    floor_met = np.isfinite(loss_c) and loss_c < args.floor
+    timeouts = sum(e.get("drain_timeouts", 0) for e in drain_events)
+    print(f"[chaos_smoke] region: {steps_a}+{steps_b}+{steps_c} steps in "
+          f"{dt:.1f}s | W {plan.old_world}->{plan.new_world}->{world} | "
+          f"loss {first_loss:.4f} -> {loss_c:.4f} (floor {args.floor}) | "
+          f"drain scope {list(drain_scope)} @ step {drain_step}")
+
+    if args.region_out:
+        doc = {
+            "tool": "chaos_smoke",
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "argv": " ".join(sys.argv[1:]),
+            "world_cycle": [plan.old_world, plan.new_world, world],
+            "slice_size": s,
+            "region_size": rz,
+            "regions": world // rz,
+            "drift_ranks": list(drift_ranks),
+            "drain": {"rank": drain_rank, "step": drain_step,
+                      "scope": list(drain_scope),
+                      "region_wide": len(drain_scope) == rz,
+                      "transitions": len(drain_events),
+                      "drain_timeouts": timeouts,
+                      "episodes": dict(sorted(
+                          (str(k), v)
+                          for k, v in controller.episodes.items()))},
+            "resize_events": controller.events,
+            "rejoin": {"rejoins": 1, "rejoined_ranks": len(lost), **{
+                k: int(barrier[k]) for k in
+                ("barrier_repairs", "repairs", "audits",
+                 "replica_variants", "last_divergent_rank",
+                 "fingerprint_bytes", "repair_bytes")}},
+            "floor": {"first_loss": first_loss, "final_loss": loss_c,
+                      "floor": args.floor, "met": bool(floor_met)},
+            "footprint": {str(plan.new_world): fp_down,
+                          str(world): fp_up},
+            "guard_silent": guard_a["notfinite_count"] == 0,
+        }
+        tmp = args.region_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.region_out)
+        print(f"[chaos_smoke] region evidence: {args.region_out}")
 
     if not np.isfinite(loss_c):
         print("[chaos_smoke] FAIL: final loss non-finite after the rejoin",

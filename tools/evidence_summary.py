@@ -355,6 +355,53 @@ def build() -> str:
             "Elastic training (graft-elastic): `chaos_smoke --elastic` → "
             + ", ".join(bits)
             + f" (`ELASTIC_LAST.json`{', ' + when if when else ''}).")
+    region = _load("REGION_LAST.json")
+    if isinstance(region, dict) and region.get("tool") == "chaos_smoke":
+        when = (region.get("captured_at") or "").split("T")[0]
+        cycle = " → ".join(str(w) for w in (region.get("world_cycle") or []))
+        drain = region.get("drain") or {}
+        rejoin = region.get("rejoin") or {}
+        floor = region.get("floor") or {}
+        fp = region.get("footprint") or {}
+        layout = (f"{region.get('regions', '?')} regions × "
+                  f"region {region.get('region_size', '?')} / "
+                  f"slice {region.get('slice_size', '?')}")
+        bits = [f"world cycle {cycle} ({layout})"]
+        if drain:
+            scoped = ("region-wide" if drain.get("region_wide")
+                      else f"PARTIAL scope {drain.get('scope')}")
+            bits.append(
+                f"{drain.get('transitions', '?')} drain transition(s) for "
+                f"drift on ranks {region.get('drift_ranks')} — {scoped}, "
+                f"{drain.get('drain_timeouts', 0)} watchdog timeout(s)")
+        if rejoin:
+            verdict = ("bit-identical" if rejoin.get("replica_variants") == 1
+                       else f"{rejoin.get('replica_variants')} variants")
+            bits.append(
+                f"region rejoin barrier: {rejoin.get('barrier_repairs', '?')}"
+                f" repair(s) for {rejoin.get('rejoins', '?')} region "
+                f"rejoin(s) ({rejoin.get('rejoined_ranks', '?')} ranks), "
+                f"replicas {verdict}")
+        if floor:
+            met = "met" if floor.get("met") else "MISSED"
+            bits.append(f"convergence floor {met} "
+                        f"(final loss {_fmt(floor.get('final_loss'), 4)} vs "
+                        f"floor {_fmt(floor.get('floor'), 2)})")
+        if fp:
+            ok = all(bool(v) for v in fp.values())
+            bits.append("re-shard footprint vs flow pass 7 model: "
+                        + ("matches at "
+                           + ", ".join(f"W={k}" for k in sorted(fp))
+                           if ok else f"MISMATCH {fp}"))
+        if region.get("guard_silent") is not None:
+            bits.append("guard "
+                        + ("silent through the drift phase"
+                           if region.get("guard_silent") else "TRIPPED"))
+        parts.append("")
+        parts.append(
+            "Cross-region elasticity (graft-region): `chaos_smoke "
+            "--region` → " + ", ".join(bits)
+            + f" (`REGION_LAST.json`{', ' + when if when else ''}).")
     adapt = _load("ADAPT_LAST.json")
     if isinstance(adapt, dict) and adapt.get("tool") == "chaos_smoke":
         when = (adapt.get("captured_at") or "").split("T")[0]

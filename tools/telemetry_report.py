@@ -150,15 +150,20 @@ def render(provenance, records, events,
                    if "wire_bytes_ici" in r]
             dcn = [float(r["wire_bytes_dcn"]) for r in records
                    if "wire_bytes_dcn" in r]
+            wan = [float(r.get("wire_bytes_wan", 0.0)) for r in records
+                   if "wire_bytes_ici" in r]
             if ici and dcn:
-                tot = sum(ici) + sum(dcn)
+                tot = sum(ici) + sum(dcn) + sum(wan)
+                wan_part = (f", wan {int(sum(wan)):,d} B" if sum(wan)
+                            else "")
                 out.append(
                     f"  per-link split: ici {int(sum(ici)):,d} B, "
-                    f"dcn {int(sum(dcn)):,d} B "
-                    f"({100.0 * sum(dcn) / max(tot, 1):.1f}% over DCN — "
-                    "flat communicators are all-ICI within one slice and "
-                    "all-DCN beyond it; a mixed split means the "
-                    "hierarchical two-level schedule)")
+                    f"dcn {int(sum(dcn)):,d} B{wan_part} "
+                    f"({100.0 * sum(dcn) / max(tot, 1):.1f}% over DCN, "
+                    f"{100.0 * sum(wan) / max(tot, 1):.1f}% over WAN — "
+                    "flat communicators bill everything at the worst "
+                    "tier they cross; a mixed split means a "
+                    "hierarchical schedule)")
             wins = fallback_windows(records)
             if wins:
                 spans = ", ".join(f"{a}..{b}" for a, b in wins)
