@@ -943,6 +943,7 @@ def _write_evidence(rows: list, path: str, metric: str, n_expected: int,
     # instead. Transient OSErrors (the flaky tunnel's NFS blips) retry with
     # the same bounded backoff the checkpointer uses.
     tmp = path + ".tmp"
+    final = {"dest": path}
 
     def write():
         with open(tmp, "w") as f:
@@ -951,13 +952,53 @@ def _write_evidence(rows: list, path: str, metric: str, n_expected: int,
             f.flush()
             os.fsync(f.fileno())
         old = load_tpu_evidence(path)
-        os.replace(tmp, path + ".partial" if _regresses(rec, old) else path)
+        final["dest"] = (path + ".partial" if _regresses(rec, old)
+                         else path)
+        os.replace(tmp, final["dest"])
 
     try:
         _evidence_retry_io(write, "TPU evidence")
     except OSError as e:
         print(f"[bench] could not save TPU evidence: {e}",
               file=sys.stderr, flush=True)
+        return
+    # Ledger emission (ISSUE 17): once the sweep is complete and landed at
+    # its real destination (not a demoted .partial), append the provenance
+    # record graft_gate audits claims against. Raise-free inside
+    # record_artifact — ledger trouble must never cost the capture.
+    in_repo = (os.path.dirname(os.path.abspath(path)) ==
+               os.path.dirname(os.path.abspath(TPU_EVIDENCE_PATH)))
+    if final["dest"] == path and not rec.get("partial") and in_repo:
+        try:
+            from grace_tpu.evidence.ledger import record_artifact
+            n_dev = rec.get("n_devices")
+            record_artifact(
+                path, id=_ledger_id(metric), metric=metric,
+                value=rec.get("vs_baseline"), claim_class="measured",
+                tool="bench", platform=rec.get("platform"),
+                chip=rec.get("chip"), n_devices=n_dev,
+                topology={"world": n_dev, "tiers": ["ici"],
+                          "slice": None, "region": None},
+                config=headline_config, lint_clean=None,
+                unit="vs_dense", abs_value=rec.get("value"))
+        except Exception as e:              # noqa: BLE001
+            print(f"[bench] ledger emission failed: {e}",
+                  file=sys.stderr, flush=True)
+
+
+# Stable ledger ids per bench metric family: re-runs append fresh records
+# under the same id (last-writer-wins in the ledger), so README markers
+# never need editing when evidence refreshes.
+_LEDGER_IDS = {
+    "resnet50_topk1pct_imgs_per_sec": "bench-headline-tpu",
+    "resnet50_all_configs_imgs_per_sec": "bench-sweep-tpu",
+    "bert_powersgd_r4_tokens_per_sec": "bench-bert-tpu",
+}
+
+
+def _ledger_id(metric: str) -> str:
+    return _LEDGER_IDS.get(
+        metric, "bench-" + metric.replace("_", "-").replace("/", "-"))
 
 
 def _evidence_retry_io(fn, what: str):
@@ -1016,6 +1057,9 @@ def load_tpu_evidence(path: str = TPU_EVIDENCE_PATH):
         return None
 
 
+# Mirrored in grace_tpu.evidence.staleness.STALE_BANNER (tests pin the
+# two equal): bench keeps a literal so `bench.py --help` on a stripped
+# box never imports the package just for the banner string.
 STALE_BANNER = "STALE — predates PRs 7–10"
 
 
@@ -1024,49 +1068,15 @@ def evidence_staleness(doc) -> list:
     set — the honesty check every reader of these files applies before
     quoting a headline (ISSUE 12). Empty list = current.
 
-    The detectors are the stamps the perf PRs introduced, so a fresh
-    capture clears them all by construction:
-
-    * PR 10 stamps ``pallas_enabled``/``fusion`` into the document-level
-      ``run_provenance`` and a first-class ``fusion`` key onto every row —
-      a document without them was captured before the bucketed executor
-      and the fused pack kernels existed;
-    * PR 7's hierarchical communicator: a sweep with no ``hier`` row never
-      measured the two-level schedule the W≥64 projections ride on.
-
-    A stale document is still evidence — of the machine state at its
-    ``captured_at`` — it just must not be presented as the current
-    system's number, which is what the ``STALE`` banner enforces in
-    ``tools/evidence_summary.py`` and the ``last_tpu`` carry-along.
+    Since ISSUE 17 this is a thin delegate to the ONE unified detector,
+    :func:`grace_tpu.evidence.staleness.evidence_staleness` — feature
+    stamps (PR 7 hier rows, PR 10 pallas/fusion provenance) plus the
+    git-ancestry check — so this function, ``evidence_summary.py``, the
+    tuner's carry-along banner, and ``graft_gate`` cannot disagree about
+    what counts as stale.
     """
-    if not isinstance(doc, dict):
-        return []
-    reasons = []
-    prov = doc.get("provenance")
-    if not isinstance(prov, dict):
-        reasons.append(
-            "no run_provenance block — the capture predates the "
-            "document-level provenance stamp (git commit unknown)")
-    elif "pallas_enabled" not in prov or "fusion" not in prov:
-        reasons.append(
-            "provenance lacks the pallas_enabled/fusion stamps (PR 10): "
-            "the headline cannot say which executor/kernel path it "
-            "measured")
-    rows = [r for r in (doc.get("rows") or [])
-            if isinstance(r, dict) and r.get("config")]
-    measured = [r for r in rows if "imgs_per_sec" in r
-                or "tokens_per_sec" in r]
-    if measured and not any("fusion" in r for r in measured):
-        reasons.append(
-            "rows predate the first-class fusion row stamp (PR 10)")
-    if len(measured) > 2:        # a sweep, not the 2-row headline pair
-        comms = {(r.get("grace_params") or {}).get("communicator")
-                 for r in measured}
-        if not comms & {"hier", "hierarchical", "hier_allreduce"}:
-            reasons.append(
-                "no hierarchical (ICI×DCN) row — the sweep predates PR 7; "
-                "refresh with `bench_all --tuned`")
-    return reasons
+    from grace_tpu.evidence.staleness import evidence_staleness as unified
+    return unified(doc)
 
 
 def _mark_stale(doc):

@@ -1,0 +1,58 @@
+"""Provenance evidence: the fourth observability subsystem.
+
+The stack reads bottom-up — telemetry (per-step records), watch (timeline
+anomaly detection), prof (device-time attribution) — and this package is
+the top layer: *who gets to quote a number, and on what evidence*.
+
+* :mod:`~grace_tpu.evidence.ledger` — the schema'd append-only
+  ``EVIDENCE/ledger.jsonl``: one record per published measurement or
+  projection, carrying the capture file's sha256, the provenance git rev,
+  platform/chip/device-count and claim class (``measured`` vs
+  ``projected``). Every evidence writer (bench, bench_all, chaos_smoke,
+  graft_tune, tpu_variants, graft_watch) appends here alongside its
+  existing JSON artifact.
+* :mod:`~grace_tpu.evidence.staleness` — the ONE staleness detector:
+  feature-stamp checks (what ``bench.evidence_staleness`` used to own)
+  plus the git-ancestry check, shared by ``evidence_summary``,
+  ``graft_tune`` and ``graft_gate`` so they cannot disagree.
+* :mod:`~grace_tpu.evidence.gate` — the claim gate: README/CHANGELOG
+  claim markers (``<!-- evidence: <ledger-id> -->``) verified against the
+  ledger (hash match, ``git merge-base --is-ancestor``, class/n_devices
+  consistency) and rendered as MEASURED / PROJECTED / STALE badges.
+* :mod:`~grace_tpu.evidence.backfill` — migration shim: mints ledger
+  records from the committed pre-ledger artifacts, stamped with each
+  file's last-touching commit.
+* :mod:`~grace_tpu.evidence.incident` — the flight recorder: a telemetry
+  :class:`~grace_tpu.telemetry.sinks.Sink` that snapshots the recent
+  record ring + watch timeline + adapt rung history (+ attached prof
+  stage attribution) into a ledger-attached incident file when a guard
+  trips, the adapt controller escalates, or a drain fires.
+
+Everything here is pure host-side stdlib — importable on a box with no
+JAX runtime, so the gate can run in CI before anything compiles.
+"""
+
+from grace_tpu.evidence.ledger import (CLAIM_CLASSES, LEDGER_PATH,
+                                       REQUIRED_FIELDS, append_record,
+                                       latest_by_id, load_ledger,
+                                       new_record, record_artifact,
+                                       repo_root, sha256_file)
+from grace_tpu.evidence.staleness import (STALE_BANNER, ancestor_verdict,
+                                          evidence_staleness,
+                                          feature_staleness, head_rev)
+from grace_tpu.evidence.gate import (gate_report, render_badges,
+                                     scan_claims, splice_badges,
+                                     verify_record)
+from grace_tpu.evidence.backfill import backfill_ledger
+from grace_tpu.evidence.incident import IncidentRecorder
+
+__all__ = [
+    "CLAIM_CLASSES", "LEDGER_PATH", "REQUIRED_FIELDS",
+    "append_record", "latest_by_id", "load_ledger", "new_record",
+    "record_artifact", "repo_root", "sha256_file",
+    "STALE_BANNER", "ancestor_verdict", "evidence_staleness",
+    "feature_staleness", "head_rev",
+    "gate_report", "render_badges", "scan_claims", "splice_badges",
+    "verify_record",
+    "backfill_ledger", "IncidentRecorder",
+]

@@ -1,0 +1,296 @@
+"""Migration shim: mint ledger records from the committed pre-ledger
+artifacts.
+
+Every artifact that predates the ledger gets a record whose ``git_rev``
+is the last commit that touched the file (``git log -n1 -- <path>``) —
+an ancestor of HEAD by construction, so honest history backfills clean
+and only an actual rewrite or a hand-edited capture renders STALE.
+
+Claim classes are assigned by what the artifact *is*, not what the
+README says about it: the committed TPU captures are all single-device
+(``n_devices: 1``) and classify MEASURED at world 1; every multi-chip
+ratio (xslice, rscatter W256, three-tier W=1024) is minted as a separate
+PROJECTED record pointing at the artifact that holds its measured base —
+exactly the measured/projected split ROADMAP item 1 demands the headline
+stop blurring.
+
+Idempotent: an id whose latest ledger record already names the same
+capture sha is skipped, so re-running the shim after an artifact refresh
+appends only what changed.
+
+Run it via ``python -m grace_tpu.evidence.backfill`` or
+``tools/graft_gate.py --backfill``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+from grace_tpu.evidence.ledger import (LEDGER_PATH, artifact_rev,
+                                       latest_by_id, load_ledger,
+                                       record_artifact, repo_root,
+                                       sha256_file)
+
+__all__ = ["backfill_ledger"]
+
+
+def _load_doc(path: str) -> Optional[Any]:
+    """One JSON doc, or the list of docs for JSONL-shaped files (bench's
+    BENCH_ALL_CPU.json is concatenated JSON docs, one per line)."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        docs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                docs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return docs or None
+
+
+def _tpu_topo(world: int = 1) -> Dict[str, Any]:
+    return {"world": world, "tiers": ["ici"], "slice": None, "region": None}
+
+
+def _cpu_mesh_topo(world: int, slice_size: Optional[int] = None,
+                   region: Optional[int] = None) -> Dict[str, Any]:
+    tiers = ["ici"]
+    if slice_size:
+        tiers = ["ici", "dcn"]
+    if region:
+        tiers = ["ici", "dcn", "wan"]
+    return {"world": world, "tiers": tiers, "slice": slice_size,
+            "region": region}
+
+
+def _bench_records(doc: Mapping, name: str) -> List[Dict[str, Any]]:
+    """Headline bench docs (BENCH_TPU_LAST / BENCH_ALL_TPU_LAST /
+    BENCH_BERT_TPU_LAST share the _write_evidence shape)."""
+    base = {
+        "bench_tpu": ("bench-headline-tpu", "bench"),
+        "bench_all_tpu": ("bench-sweep-tpu", "bench_all"),
+        "bench_bert_tpu": ("bench-bert-tpu", "tpu_bert_bench"),
+    }[name]
+    rid, tool = base
+    n_dev = doc.get("n_devices") or 1
+    rec = {
+        "id": rid, "metric": doc.get("metric"),
+        "value": doc.get("vs_baseline"),
+        "claim_class": "measured", "tool": tool,
+        "platform": doc.get("platform"), "chip": doc.get("chip"),
+        "n_devices": n_dev, "topology": _tpu_topo(n_dev),
+        "config": None, "lint_clean": None,
+        "unit": "vs_dense", "captured_at": doc.get("captured_at"),
+        "abs_value": doc.get("value"),
+    }
+    out = [rec]
+    # The multi-chip story each capture carries: a PROJECTED twin at the
+    # wire-model world sizes, pointing at the same capture file.
+    proj_id = {"bench_tpu": "proj-topk1pct-xslice",
+               "bench_all_tpu": "proj-sweep-xslice",
+               "bench_bert_tpu": "proj-bert-routed-xslice"}[name]
+    proj_metric = {"bench_tpu": "resnet50_topk1pct_xslice_vs_dense",
+                   "bench_all_tpu": "resnet50_sweep_xslice_vs_dense",
+                   "bench_bert_tpu": "bert_routed_xslice_vs_dense"}[name]
+    out.append({
+        "id": proj_id, "metric": proj_metric, "value": None,
+        "claim_class": "projected", "tool": tool,
+        "platform": doc.get("platform"), "chip": doc.get("chip"),
+        "n_devices": n_dev,
+        "topology": {"world": 256, "tiers": ["ici", "dcn"],
+                     "slice": 8, "region": None},
+        "config": None, "lint_clean": None,
+        "unit": "vs_dense",
+        "note": "static wire-model projection from the single-device "
+                "capture (bench PROJECTION_MODEL constants)",
+        "captured_at": doc.get("captured_at"),
+    })
+    return out
+
+
+def _artifact_specs() -> List[Dict[str, Any]]:
+    """One entry per committed artifact: capture path + a builder that
+    turns the loaded doc into ledger-record dicts."""
+
+    def chaos(doc, rid, metric, value, slice_size=None, region=None):
+        world = doc.get("world") or 8
+        return [{
+            "id": rid, "metric": metric, "value": value,
+            "claim_class": "measured", "tool": doc.get("tool",
+                                                       "chaos_smoke"),
+            "platform": "cpu", "chip": "cpu", "n_devices": world,
+            "topology": _cpu_mesh_topo(world, slice_size, region),
+            "config": doc.get("argv"), "lint_clean": None,
+            "captured_at": doc.get("captured_at"),
+        }]
+
+    return [
+        {"capture": "BENCH_TPU_LAST.json",
+         "build": lambda d: _bench_records(d, "bench_tpu")},
+        {"capture": "BENCH_ALL_TPU_LAST.json",
+         "build": lambda d: _bench_records(d, "bench_all_tpu")},
+        {"capture": "BENCH_BERT_TPU_LAST.json",
+         "build": lambda d: _bench_records(d, "bench_bert_tpu")},
+        {"capture": "BENCH_ALL_CPU.json",
+         "build": lambda docs: [{
+             "id": "bench-sweep-cpu", "metric": "resnet50_cpu_sweep_rows",
+             "value": len(docs) if isinstance(docs, list) else 1,
+             "claim_class": "measured", "tool": "bench_all",
+             "platform": "cpu", "chip": "cpu", "n_devices": 8,
+             "topology": _cpu_mesh_topo(8), "config": None,
+             "lint_clean": None,
+             "note": "8-device simulated-CPU mesh e2e sweep",
+         }]},
+        {"capture": "TPU_VARIANTS.jsonl",
+         "build": lambda docs: [{
+             "id": "variants-tpu", "metric": "resnet50_variant_rows",
+             "value": len(docs) if isinstance(docs, list) else 1,
+             "claim_class": "measured", "tool": "tpu_variants",
+             "platform": "tpu", "chip": "TPU v5 lite", "n_devices": 1,
+             "topology": _tpu_topo(1), "config": None,
+             "lint_clean": None,
+         }]},
+        {"capture": "ADAPT_LAST.json",
+         "build": lambda d: chaos(
+             d, "adapt-drill", "adapt_ordering_ok",
+             bool(d.get("ordering_ok")))},
+        {"capture": "ELASTIC_LAST.json",
+         "build": lambda d: chaos(
+             d, "elastic-drill", "elastic_floor_met",
+             bool((d.get("floor") or {}).get("met")),
+             slice_size=d.get("slice_size"))},
+        {"capture": "REGION_LAST.json",
+         "build": lambda d: chaos(
+             d, "region-drill", "region_floor_met",
+             bool((d.get("floor") or {}).get("met")),
+             slice_size=d.get("slice_size"),
+             region=d.get("region_size"))},
+        {"capture": "WATCH_LAST.json",
+         "build": lambda d: [{
+             "id": "watch-drill", "metric": "watch_anomalies",
+             "value": d.get("anomalies"), "claim_class": "measured",
+             "tool": d.get("tool", "graft_watch"), "platform": "cpu",
+             "chip": "cpu", "n_devices": 8, "topology": _cpu_mesh_topo(8),
+             "config": d.get("artifact"), "lint_clean": None,
+             "captured_at": d.get("captured_at"),
+         }]},
+        {"capture": "TUNE_LAST.json",
+         "build": lambda d: [{
+             "id": "tune-winner", "metric": "tune_winner_config",
+             "value": ((d.get("winner") or {}).get("candidate")),
+             "claim_class": "measured", "tool": d.get("tool",
+                                                      "graft_tune"),
+             "platform": (d.get("provenance") or {}).get("platform"),
+             "chip": (d.get("provenance") or {}).get("device"),
+             "n_devices": (d.get("provenance") or {}).get("n_devices"),
+             "topology": _cpu_mesh_topo(
+                 (d.get("provenance") or {}).get("n_devices") or 8),
+             "config": (d.get("winner") or {}).get("grace_params"),
+             "lint_clean": bool(d.get("ok")),
+             "captured_at": d.get("captured_at"),
+         }, {
+             "id": "proj-tune-w256-static", "metric":
+                 "tune_static_ranking_w256",
+             "value": None, "claim_class": "projected",
+             "tool": d.get("tool", "graft_tune"),
+             "platform": (d.get("provenance") or {}).get("platform"),
+             "chip": (d.get("provenance") or {}).get("device"),
+             "n_devices": (d.get("provenance") or {}).get("n_devices"),
+             "topology": {"world": 256, "tiers": ["ici", "dcn"],
+                          "slice": 8, "region": None},
+             "config": None, "lint_clean": bool(d.get("ok")),
+             "note": "static per-link pricing ranking (W256/slice8)",
+             "captured_at": d.get("captured_at"),
+         }, {
+             "id": "proj-three-tier-w1024", "metric":
+                 "three_tier_w1024_vs_dense",
+             "value": None, "claim_class": "projected",
+             "tool": d.get("tool", "graft_tune"),
+             "platform": (d.get("provenance") or {}).get("platform"),
+             "chip": (d.get("provenance") or {}).get("device"),
+             "n_devices": (d.get("provenance") or {}).get("n_devices"),
+             "topology": {"world": 1024, "tiers": ["ici", "dcn", "wan"],
+                          "slice": 8, "region": 256},
+             "config": None, "lint_clean": bool(d.get("ok")),
+             "note": "W=1024 three-tier funnel, static wire model "
+                     "(4 regions x 256, slices of 8)",
+             "captured_at": d.get("captured_at"),
+         }]},
+        {"capture": "LINT_LAST.json",
+         "build": lambda d: [{
+             "id": "lint-clean", "metric": "lint_configs_clean",
+             "value": d.get("configs_audited"),
+             "claim_class": "measured", "tool": d.get("tool",
+                                                      "graft_lint"),
+             "platform": "host", "chip": None, "n_devices": None,
+             "topology": {"world": d.get("world"), "tiers": None,
+                          "slice": None, "region": None},
+             "config": None,
+             "lint_clean": (d.get("errors") == 0
+                            and d.get("warnings") == 0),
+             "captured_at": d.get("captured_at"),
+         }]},
+        {"capture": "PROF_LAST.json",
+         "build": lambda d: [{
+             "id": "prof-canned-trace", "metric":
+                 "prof_overlap_fraction",
+             "value": d.get("overlap_fraction"),
+             "claim_class": "measured", "tool": d.get("tool",
+                                                      "perf_report"),
+             "platform": "cpu", "chip": "cpu", "n_devices": None,
+             "topology": None, "config": d.get("trace"),
+             "lint_clean": None, "note": d.get("note"),
+             "captured_at": d.get("captured_at"),
+         }]},
+    ]
+
+
+def backfill_ledger(root: Optional[str] = None,
+                    ledger_path: Optional[str] = None,
+                    verbose: bool = False) -> List[Dict[str, Any]]:
+    """Mint records for every committed artifact not yet in the ledger.
+    Returns the records appended this call."""
+    root = root or repo_root()
+    ledger_path = ledger_path or os.path.join(root, "EVIDENCE",
+                                              "ledger.jsonl")
+    current = latest_by_id(load_ledger(ledger_path))
+    appended: List[Dict[str, Any]] = []
+    for spec in _artifact_specs():
+        rel = spec["capture"]
+        path = os.path.join(root, rel)
+        doc = _load_doc(path)
+        if doc is None:
+            continue
+        sha = sha256_file(path)
+        rev = artifact_rev(rel, root)
+        for rec in spec["build"](doc):
+            prior = current.get(rec["id"])
+            if prior is not None and prior.get("capture_sha256") == sha:
+                continue                       # already minted for this sha
+            out = record_artifact(
+                path, ledger_path=ledger_path, git_rev=rev,
+                **{k: v for k, v in rec.items() if k != "capture"})
+            if out is not None:
+                appended.append(out)
+                current[out["id"]] = out
+                if verbose:
+                    print(f"[backfill] {out['id']}: "
+                          f"{out['claim_class']} {out['metric']} "
+                          f"@ {str(rev)[:12]}")
+    return appended
+
+
+if __name__ == "__main__":
+    recs = backfill_ledger(verbose=True)
+    print(f"[backfill] appended {len(recs)} record(s) to {LEDGER_PATH}")

@@ -1,0 +1,198 @@
+"""The evidence ledger: one append-only JSONL of provenance records.
+
+Twelve scattered ``*_LAST.json``/``BENCH_*.json`` artifacts each grew
+their own provenance idiom (bench rows carry ``n_devices``/``chip``,
+chaos_smoke docs only a ``captured_at``). The ledger is the one schema
+they all now feed: every record names the capture file it attests, the
+sha256 of that file *at record time*, the git rev the capture was taken
+at, and whether the headline number is ``measured`` on real devices or
+``projected`` through the static wire model. ``tools/graft_gate.py``
+audits README/CHANGELOG claims against these records.
+
+Append-only with last-writer-wins per ``id``: a re-run of bench appends a
+fresh ``bench-headline-tpu`` record rather than rewriting history, and
+:func:`latest_by_id` resolves the current one. Torn trailing lines (a
+killed writer) are skipped on load, same policy as the timeline loader.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, Iterable, List, Mapping, Optional
+
+__all__ = ["CLAIM_CLASSES", "LEDGER_PATH", "REQUIRED_FIELDS",
+           "append_record", "latest_by_id", "load_ledger", "new_record",
+           "record_artifact", "repo_root", "sha256_file", "git_head_rev",
+           "artifact_rev"]
+
+
+def repo_root() -> str:
+    """Repo root inferred from this file (``grace_tpu/evidence/`` → up 2)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+LEDGER_PATH = os.path.join(repo_root(), "EVIDENCE", "ledger.jsonl")
+
+CLAIM_CLASSES = ("measured", "projected")
+
+# The pinned schema. `topology` is a dict with at least `world`; `tiers`
+# (e.g. ["ici"], ["ici","dcn","wan"]), `slice` and `region` ride along
+# when the capture/projection has them. `config` is the grace_params-style
+# dict (or config name) the number belongs to. `lint_clean` records
+# whether the config passed graft-lint at capture time (None = not
+# audited).
+REQUIRED_FIELDS = ("id", "metric", "value", "claim_class", "capture",
+                   "capture_sha256", "git_rev", "platform", "chip",
+                   "n_devices", "topology", "config", "lint_clean",
+                   "tool", "timestamp")
+
+
+def sha256_file(path: str) -> Optional[str]:
+    try:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+    except OSError:
+        return None
+
+
+def _git(args: List[str], root: Optional[str] = None) -> Optional[str]:
+    try:
+        out = subprocess.run(["git"] + args, cwd=root or repo_root(),
+                             capture_output=True, text=True, timeout=10)
+    except Exception:
+        return None
+    if out.returncode != 0:
+        return None
+    return out.stdout.strip() or None
+
+
+def git_head_rev(root: Optional[str] = None) -> Optional[str]:
+    """Full HEAD rev of the repo, or None on a broken/absent checkout."""
+    return _git(["rev-parse", "HEAD"], root)
+
+
+def artifact_rev(relpath: str, root: Optional[str] = None) -> Optional[str]:
+    """Rev of the last commit that touched ``relpath`` — the honest
+    provenance rev for a committed pre-ledger artifact (backfill), an
+    ancestor of HEAD by construction."""
+    return _git(["log", "-n1", "--format=%H", "--", relpath], root)
+
+
+def _utc_now() -> str:
+    return datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+def new_record(**fields: Any) -> Dict[str, Any]:
+    """Build + validate a ledger record. Unknown extra keys are kept (the
+    schema is a floor, not a ceiling); missing required keys and bad claim
+    classes raise so a writer bug cannot mint half a record."""
+    rec = dict(fields)
+    rec.setdefault("timestamp", _utc_now())
+    missing = [k for k in REQUIRED_FIELDS if k not in rec]
+    if missing:
+        raise ValueError(f"ledger record missing fields: {missing}")
+    if rec["claim_class"] not in CLAIM_CLASSES:
+        raise ValueError(
+            f"claim_class must be one of {CLAIM_CLASSES}, "
+            f"got {rec['claim_class']!r}")
+    if not isinstance(rec["id"], str) or not rec["id"]:
+        raise ValueError("ledger record needs a non-empty string id")
+    topo = rec.get("topology")
+    if topo is not None and not isinstance(topo, Mapping):
+        raise ValueError("topology must be a dict (world/tiers/slice/"
+                         "region) or None")
+    return rec
+
+
+def append_record(record: Mapping[str, Any],
+                  path: str = LEDGER_PATH) -> Dict[str, Any]:
+    """Validate and append one record; whole-line + fsync so a killed
+    writer leaves at worst a torn tail the loader skips."""
+    rec = new_record(**dict(record))
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    line = json.dumps(rec, sort_keys=True, default=str)
+    with open(path, "a") as f:
+        f.write(line + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return rec
+
+
+def load_ledger(path: str = LEDGER_PATH) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue                     # torn tail line
+                if isinstance(doc, dict) and doc.get("id"):
+                    records.append(doc)
+    except OSError:
+        return []
+    return records
+
+
+def latest_by_id(records: Iterable[Mapping[str, Any]]) -> Dict[str, Dict]:
+    """Append-order last-writer-wins resolution of the current record per
+    id."""
+    out: Dict[str, Dict] = {}
+    for rec in records:
+        out[str(rec.get("id"))] = dict(rec)
+    return out
+
+
+def record_artifact(capture_path: str, *, id: str, metric: str,
+                    value: Any, claim_class: str, tool: str,
+                    platform: Optional[str] = None,
+                    chip: Optional[str] = None,
+                    n_devices: Optional[int] = None,
+                    topology: Optional[Mapping[str, Any]] = None,
+                    config: Any = None,
+                    lint_clean: Optional[bool] = None,
+                    git_rev: Optional[str] = None,
+                    ledger_path: str = LEDGER_PATH,
+                    **extra: Any) -> Optional[Dict[str, Any]]:
+    """The one call every evidence writer makes after landing its JSON
+    artifact: hash the capture, stamp the current rev, append. Raise-free
+    by design — ledger emission must never take down the measurement that
+    produced the evidence — a failure prints to stderr and returns None.
+    """
+    try:
+        root = repo_root()
+        capture_abs = (capture_path if os.path.isabs(capture_path)
+                       else os.path.join(root, capture_path))
+        try:
+            capture_rel = os.path.relpath(capture_abs, root)
+        except ValueError:                       # different drive (win)
+            capture_rel = capture_abs
+        if capture_rel.startswith(".."):
+            capture_rel = capture_abs            # outside the repo: keep abs
+        rec = new_record(
+            id=id, metric=metric, value=value, claim_class=claim_class,
+            capture=capture_rel, capture_sha256=sha256_file(capture_abs),
+            git_rev=git_rev if git_rev is not None else git_head_rev(root),
+            platform=platform, chip=chip, n_devices=n_devices,
+            topology=dict(topology) if topology is not None else None,
+            config=config, lint_clean=lint_clean, tool=tool, **extra)
+        return append_record(rec, ledger_path)
+    except Exception as e:                       # noqa: BLE001
+        print(f"[evidence] ledger append failed for {id!r}: {e}",
+              file=sys.stderr, flush=True)
+        return None

@@ -1,0 +1,131 @@
+"""The ONE staleness detector.
+
+Before this module, three readers each decided freshness for themselves:
+``bench.evidence_staleness`` (feature stamps), ``evidence_summary``'s
+banner (delegating to bench), and the tuner's carry-along marking. They
+agreed only by discipline. Now they all call here, and the claim gate
+adds the structural check the feature stamps can't express: the
+provenance rev must be an **ancestor of HEAD** (``git merge-base
+--is-ancestor``), or the capture was taken on a branch/rewrite whose
+numbers this tree never saw.
+
+Two policies on one primitive (:func:`ancestor_verdict`):
+
+* :func:`evidence_staleness` (document policy, what ``bench`` delegates
+  to) — adds an ancestry reason only on a *definite* non-ancestor. An
+  unresolvable rev (short rev from a shallow clone, a doc copied from
+  another checkout) is not evidence of staleness; the feature-stamp
+  detectors still apply.
+* ``graft_gate`` (ledger policy, in :mod:`~grace_tpu.evidence.gate`) —
+  strict: a cited record whose rev cannot be proven an ancestor renders
+  STALE. Claims quote the gate, so claims get the strict policy.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Any, List, Mapping, Optional
+
+from grace_tpu.evidence.ledger import git_head_rev, repo_root
+
+__all__ = ["STALE_BANNER", "ancestor_verdict", "evidence_staleness",
+           "feature_staleness", "ancestry_staleness", "head_rev"]
+
+STALE_BANNER = "STALE — predates PRs 7–10"
+
+head_rev = git_head_rev        # re-export under the reader-facing name
+
+
+def ancestor_verdict(rev: Optional[str], root: Optional[str] = None,
+                     head: str = "HEAD") -> str:
+    """``git merge-base --is-ancestor rev head`` → one of:
+
+    * ``"ancestor"`` — rev is reachable from ``head`` (exit 0);
+    * ``"not_ancestor"`` — both commits exist, rev is not reachable
+      (exit 1): the capture predates a rewrite or lives on a branch;
+    * ``"unknown"`` — rev doesn't resolve in this clone (exit 128 etc.);
+    * ``"no_git"`` — no usable git at all (CI tarball, broken checkout).
+    """
+    if not rev:
+        return "unknown"
+    try:
+        out = subprocess.run(
+            ["git", "merge-base", "--is-ancestor", str(rev), head],
+            cwd=root or repo_root(), capture_output=True, timeout=10)
+    except Exception:
+        return "no_git"
+    if out.returncode == 0:
+        return "ancestor"
+    if out.returncode == 1:
+        return "not_ancestor"
+    return "unknown"
+
+
+def feature_staleness(doc: Any) -> List[str]:
+    """Why a persisted TPU evidence document predates the current feature
+    set — the detectors are the stamps the perf PRs introduced, so a
+    fresh capture clears them all by construction:
+
+    * PR 10 stamps ``pallas_enabled``/``fusion`` into the document-level
+      ``run_provenance`` and a first-class ``fusion`` key onto every row —
+      a document without them was captured before the bucketed executor
+      and the fused pack kernels existed;
+    * PR 7's hierarchical communicator: a sweep with no ``hier`` row
+      never measured the two-level schedule the W≥64 projections ride on.
+    """
+    if not isinstance(doc, Mapping):
+        return []
+    reasons = []
+    prov = doc.get("provenance")
+    if not isinstance(prov, Mapping):
+        reasons.append(
+            "no run_provenance block — the capture predates the "
+            "document-level provenance stamp (git commit unknown)")
+    elif "pallas_enabled" not in prov or "fusion" not in prov:
+        reasons.append(
+            "provenance lacks the pallas_enabled/fusion stamps (PR 10): "
+            "the headline cannot say which executor/kernel path it "
+            "measured")
+    rows = [r for r in (doc.get("rows") or [])
+            if isinstance(r, Mapping) and r.get("config")]
+    measured = [r for r in rows if "imgs_per_sec" in r
+                or "tokens_per_sec" in r]
+    if measured and not any("fusion" in r for r in measured):
+        reasons.append(
+            "rows predate the first-class fusion row stamp (PR 10)")
+    if len(measured) > 2:        # a sweep, not the 2-row headline pair
+        comms = {(r.get("grace_params") or {}).get("communicator")
+                 for r in measured}
+        if not comms & {"hier", "hierarchical", "hier_allreduce"}:
+            reasons.append(
+                "no hierarchical (ICI×DCN) row — the sweep predates PR 7; "
+                "refresh with `bench_all --tuned`")
+    return reasons
+
+
+def ancestry_staleness(rev: Optional[str],
+                       root: Optional[str] = None) -> List[str]:
+    """Document-policy ancestry reasons: only a *definite* non-ancestor
+    counts (see module docstring for why unknown revs pass here but fail
+    the gate)."""
+    if ancestor_verdict(rev, root) == "not_ancestor":
+        return [f"provenance rev {rev} is not an ancestor of HEAD — the "
+                "capture predates a history rewrite or was taken on "
+                "another branch"]
+    return []
+
+
+def evidence_staleness(doc: Any, root: Optional[str] = None) -> List[str]:
+    """The unified document detector ``bench.evidence_staleness`` now
+    delegates to: feature stamps + definite-non-ancestor provenance rev.
+    Empty list = current. A stale document is still evidence — of the
+    machine state at its ``captured_at`` — it just must not be presented
+    as the current system's number, which is what the STALE banner
+    enforces in ``tools/evidence_summary.py`` and the ``last_tpu``
+    carry-along."""
+    reasons = feature_staleness(doc)
+    if isinstance(doc, Mapping):
+        prov = doc.get("provenance")
+        if isinstance(prov, Mapping):
+            reasons += ancestry_staleness(prov.get("git_commit"), root)
+    return reasons

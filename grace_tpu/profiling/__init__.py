@@ -10,6 +10,10 @@ reads the evidence back:
   fraction** (collective time hidden under compute, from device timelines),
   and step-time percentiles. Pure host-side; runs on a CPU-only box against
   a saved trace.
+* :mod:`~grace_tpu.profiling.trace_export` — the write side: spans back
+  out as Chrome-trace JSON (``parse_chrome_trace`` round-trips it
+  exactly) plus :func:`merge_host_traces` so a multi-host capture ships
+  one merged per-hop/per-tier timeline.
 * :mod:`~grace_tpu.profiling.recorder` — :class:`ProfileRecorder`, the
   runtime side: step-time percentiles, compile/retrace events (the dynamic
   twin of graft-lint's ``signature_stability`` pass), device-memory
@@ -38,6 +42,9 @@ from grace_tpu.profiling.trace_analysis import (Span, TraceAnalysis,
                                                 overlap_us,
                                                 parse_chrome_trace,
                                                 parse_xplane)
+from grace_tpu.profiling.trace_export import (chrome_trace_doc,
+                                              merge_host_traces,
+                                              write_chrome_trace)
 
 __all__ = [
     "ProfileRecorder", "check_state_footprint", "compile_count",
@@ -47,4 +54,5 @@ __all__ = [
     "enrich_spans", "find_latest_trace", "hlo_scope_map",
     "interval_union_us", "load_trace_events", "overlap_us",
     "parse_chrome_trace", "parse_xplane",
+    "chrome_trace_doc", "merge_host_traces", "write_chrome_trace",
 ]

@@ -169,7 +169,9 @@ def run_tune(topologies: Sequence[Union[str, TuneTopology]], *,
 
 def write_tune_evidence(doc: Dict[str, Any],
                         path: str = TUNE_EVIDENCE_PATH) -> None:
-    """Atomic tmp+fsync+replace, the repo's evidence-write idiom."""
+    """Atomic tmp+fsync+replace, the repo's evidence-write idiom, plus a
+    ledger record (repo-root artifacts only — a test writing to tmp_path
+    must not touch EVIDENCE/ledger.jsonl)."""
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1)
@@ -177,3 +179,24 @@ def write_tune_evidence(doc: Dict[str, Any],
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    if (os.path.dirname(os.path.abspath(path)) !=
+            os.path.dirname(os.path.abspath(TUNE_EVIDENCE_PATH))):
+        return
+    try:
+        from grace_tpu.evidence.ledger import record_artifact
+        prov = doc.get("provenance") or {}
+        winner = doc.get("winner") or {}
+        n_dev = prov.get("n_devices")
+        record_artifact(
+            path, id="tune-winner", metric="tune_winner_config",
+            value=winner.get("candidate"), claim_class="measured",
+            tool="graft_tune", platform=prov.get("platform"),
+            chip=prov.get("device"), n_devices=n_dev,
+            topology={"world": n_dev, "tiers": ["ici"], "slice": None,
+                      "region": None},
+            config=winner.get("grace_params"),
+            lint_clean=bool(doc.get("ok")))
+    except Exception as e:                               # noqa: BLE001
+        import sys
+        print(f"[graft_tune] ledger emission failed: {e}",
+              file=sys.stderr, flush=True)

@@ -1,0 +1,382 @@
+"""graft-evidence: the provenance ledger, claim gate and flight recorder.
+
+Pins the ISSUE-17 contracts:
+
+* ledger schema — required fields enforced at mint time, append-only
+  with last-writer-wins per id, torn tails skipped;
+* gate verdicts — sha mismatch → STALE, non-ancestor provenance rev →
+  STALE (strict policy), measured claim whose topology world exceeds its
+  capture's n_devices → gate failure;
+* the two ancestry policies — an *unresolvable* rev passes the document
+  detector (``bench.evidence_staleness``) but fails the gate;
+* claim scanning — ratio-vs-dense lines must sit in a marker-carrying
+  paragraph; fences and the generated evidence/gate blocks are exempt;
+* the real repo's gate passes (the --ci acceptance criterion);
+* Chrome-trace export → ``parse_chrome_trace`` round-trips exactly,
+  including the multi-host merge;
+* the incident recorder triggers, debounces, and attaches to the ledger;
+* backfill is idempotent.
+
+All host-side and device-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+
+import pytest
+
+from grace_tpu.evidence import (backfill_ledger, gate, incident, ledger,
+                                staleness)
+
+pytestmark = pytest.mark.evidence
+
+REPO = ledger.repo_root()
+
+
+def _rec(**over):
+    """A valid record template; tests override the field under test."""
+    base = dict(id="t-rec", metric="m", value=1.0, claim_class="measured",
+                capture="cap.json", capture_sha256="0" * 64,
+                git_rev="deadbeef", platform="cpu", chip=None, n_devices=1,
+                topology={"world": 1, "tiers": ["ici"], "slice": None,
+                          "region": None},
+                config="cfg", lint_clean=None, tool="test")
+    base.update(over)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# ledger schema
+
+
+def test_new_record_validates_schema():
+    rec = ledger.new_record(**_rec())
+    assert rec["timestamp"]                       # defaulted
+    with pytest.raises(ValueError, match="missing fields"):
+        ledger.new_record(**{k: v for k, v in _rec().items()
+                             if k != "capture_sha256"})
+    with pytest.raises(ValueError, match="claim_class"):
+        ledger.new_record(**_rec(claim_class="vibes"))
+    with pytest.raises(ValueError, match="topology"):
+        ledger.new_record(**_rec(topology="8x1"))
+
+
+def test_append_load_latest_and_torn_tail(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.append_record(_rec(id="a", value=1.0), path)
+    ledger.append_record(_rec(id="b", value=2.0), path)
+    ledger.append_record(_rec(id="a", value=3.0), path)
+    with open(path, "a") as f:
+        f.write('{"id": "torn", "met')          # killed writer
+    recs = ledger.load_ledger(path)
+    assert [r["id"] for r in recs] == ["a", "b", "a"]
+    latest = ledger.latest_by_id(recs)
+    assert latest["a"]["value"] == 3.0          # last writer wins
+    assert latest["b"]["value"] == 2.0
+
+
+def test_record_artifact_hashes_capture(tmp_path):
+    cap = tmp_path / "cap.json"
+    cap.write_text('{"rows": []}\n')
+    path = str(tmp_path / "ledger.jsonl")
+    rec = ledger.record_artifact(
+        str(cap), id="x", metric="m", value=0.5, claim_class="projected",
+        tool="test", platform="cpu", n_devices=1,
+        topology={"world": 8, "tiers": ["ici"]}, config=None,
+        lint_clean=None, ledger_path=path)
+    assert rec is not None
+    assert rec["capture_sha256"] == ledger.sha256_file(str(cap))
+    assert ledger.load_ledger(path)[0]["id"] == "x"
+    # raise-free contract: a bad claim_class reports None, never raises
+    assert ledger.record_artifact(
+        str(cap), id="y", metric="m", value=0.5, claim_class="vibes",
+        tool="test", ledger_path=path) is None
+
+
+# ---------------------------------------------------------------------------
+# gate verdicts
+
+
+def test_verify_record_sha_mismatch_is_stale(tmp_path):
+    cap = tmp_path / "cap.json"
+    cap.write_text("v1\n")
+    rec = _rec(capture=str(cap), capture_sha256=ledger.sha256_file(str(cap)),
+               git_rev=ledger.git_head_rev())
+    assert gate.verify_record(rec)["status"] == "MEASURED"
+    cap.write_text("v2 — capture edited after the record was minted\n")
+    res = gate.verify_record(rec)
+    assert res["status"] == "STALE"
+    assert any("hash mismatch" in f for f in res["failures"])
+
+
+def test_verify_record_class_mismatch(tmp_path):
+    cap = tmp_path / "cap.json"
+    cap.write_text("v1\n")
+    sha = ledger.sha256_file(str(cap))
+    head = ledger.git_head_rev()
+    # A single-chip capture presented as a MEASURED world-256 claim is
+    # the exact dishonesty the gate exists for.
+    bad = _rec(capture=str(cap), capture_sha256=sha, git_rev=head,
+               n_devices=1, topology={"world": 256, "tiers": ["ici", "dcn"]})
+    res = gate.verify_record(bad)
+    assert res["status"] == "STALE"
+    assert any("class mismatch" in f for f in res["failures"])
+    # ... while the same capture, honestly classed, is PROJECTED.
+    ok = dict(bad, claim_class="projected")
+    assert gate.verify_record(ok)["status"] == "PROJECTED"
+    assert gate.verify_record(None)["status"] == "STALE"
+
+
+def _seeded_history(tmp_path):
+    """A throwaway repo whose history forks: main A--C, side branch B.
+    Returns (repo_dir, side_rev_B) — B is NOT an ancestor of HEAD (C)."""
+    repo = str(tmp_path / "hist")
+    os.makedirs(repo)
+
+    def git(*args):
+        out = subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             "-c", "commit.gpgsign=false"] + list(args),
+            cwd=repo, capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        return out.stdout.strip()
+
+    git("init", "-q")
+    git("commit", "-q", "--allow-empty", "-m", "A")
+    git("checkout", "-q", "-b", "side")
+    git("commit", "-q", "--allow-empty", "-m", "B")
+    side_rev = git("rev-parse", "HEAD")
+    git("checkout", "-q", "-")
+    git("commit", "-q", "--allow-empty", "-m", "C")
+    return repo, side_rev
+
+
+def test_non_ancestor_rev_renders_stale(tmp_path):
+    repo, side_rev = _seeded_history(tmp_path)
+    assert staleness.ancestor_verdict(side_rev, repo) == "not_ancestor"
+    assert staleness.ancestor_verdict(
+        staleness.head_rev(repo), repo) == "ancestor"
+    cap = tmp_path / "hist" / "cap.json"
+    cap.write_text("x\n")
+    rec = _rec(capture="cap.json",
+               capture_sha256=ledger.sha256_file(str(cap)),
+               git_rev=side_rev)
+    res = gate.verify_record(rec, root=repo)
+    assert res["status"] == "STALE"
+    assert any("not an ancestor" in f for f in res["failures"])
+
+
+def test_ancestry_policies_differ_on_unresolvable_rev(tmp_path):
+    # "abc1234" is the fake rev the pinned tuning tests stamp into fresh
+    # docs: unresolvable, so the document policy must NOT flag it...
+    assert staleness.ancestor_verdict("abc1234") == "unknown"
+    assert staleness.ancestry_staleness("abc1234") == []
+    # ...while the gate, which backs published claims, must.
+    cap = tmp_path / "cap.json"
+    cap.write_text("x\n")
+    rec = _rec(capture=str(cap),
+               capture_sha256=ledger.sha256_file(str(cap)),
+               git_rev="abc1234")
+    res = gate.verify_record(rec)
+    assert res["status"] == "STALE"
+    assert any("unprovable" in f or "does not resolve" in f
+               for f in res["failures"])
+
+
+def test_bench_delegates_to_unified_staleness():
+    import bench
+    assert bench.STALE_BANNER == staleness.STALE_BANNER
+    doc = {"provenance": {"git_commit": "abc1234", "pallas_enabled": True,
+                          "fusion": "per_leaf"},
+           "rows": [{"config": "c", "imgs_per_sec": 1.0,
+                     "fusion": "per_leaf"}]}
+    assert bench.evidence_staleness(doc) == staleness.evidence_staleness(doc)
+    assert bench.evidence_staleness(doc) == []
+    assert bench.evidence_staleness({"rows": []})  # pre-provenance doc
+
+
+# ---------------------------------------------------------------------------
+# claim scanning
+
+
+def test_scan_claims_paragraph_marking():
+    text = "\n".join([
+        "The headline runs 0.9895× dense on one chip.",
+        "<!-- evidence: bench-headline-tpu proj-topk1pct-xslice -->",
+        "",
+        "PowerSGD projects 1.47–1.54× vs dense at W=64.",
+        "",
+        "```",
+        "code claims 3× dense but fences are exempt",
+        "```",
+        "<!-- evidence:begin -->",
+        "| generated table says 8.7× dense |",
+        "<!-- evidence:end -->",
+    ])
+    scan = gate.scan_claims(text)
+    assert scan["cited_ids"] == ["bench-headline-tpu",
+                                 "proj-topk1pct-xslice"]
+    assert [n for n, _ in scan["claims"]] == [1, 4]
+    assert [n for n, _ in scan["unmarked"]] == [4]   # only the bare one
+
+
+def test_scan_claims_marker_covers_adjacent_paragraph():
+    text = "\n".join([
+        "<!-- evidence: some-id -->",
+        "A table headline at 2.2× vs dense.",
+    ])
+    assert gate.scan_claims(text)["unmarked"] == []
+
+
+def test_gate_report_passes_on_this_repo():
+    """The --ci acceptance criterion: every README/CHANGELOG ratio is
+    marked and every cited ledger record verifies on HEAD."""
+    report = gate.gate_report()
+    assert report["failures"] == []
+    assert report["ok"]
+    statuses = {cid: r["status"] for cid, r in report["records"].items()}
+    # Single-device captures are MEASURED; cross-slice / three-tier /
+    # W=256 ratios ride the analytic wire model and must say PROJECTED.
+    assert statuses["bench-headline-tpu"] == "MEASURED"
+    for cid, status in statuses.items():
+        if cid.startswith("proj-"):
+            assert status == "PROJECTED", (cid, status)
+    badges = gate.render_badges(report)
+    assert gate.GATE_BEGIN in badges and "**MEASURED**" in badges
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export round-trip
+
+
+def _spans():
+    from grace_tpu.profiling.trace_analysis import Span
+    return [
+        Span(name="allreduce-hop0", ts=0.0, dur=10.0,
+             device="/device:TPU:0", lane="XLA Ops", scope="ici"),
+        Span(name="allreduce-hop1", ts=10.0, dur=12.0,
+             device="/device:TPU:0", lane="XLA Ops", scope="dcn"),
+        Span(name="step", ts=0.0, dur=25.0,
+             device="/device:TPU:0", lane="Steps", scope=""),
+        Span(name="allreduce-hop0", ts=1.0, dur=9.0,
+             device="/device:TPU:1", lane="XLA Ops", scope="ici"),
+    ]
+
+
+@pytest.mark.parametrize("suffix", [".json", ".json.gz"])
+def test_chrome_trace_round_trip(tmp_path, suffix):
+    from grace_tpu.profiling.trace_analysis import load_trace_events
+    from grace_tpu.profiling.trace_export import write_chrome_trace
+    spans = _spans()
+    path = str(tmp_path / f"trace{suffix}")
+    write_chrome_trace(spans, path)
+    assert set(load_trace_events(path)) == set(spans)
+
+
+def test_chrome_trace_doc_is_deterministic():
+    from grace_tpu.profiling.trace_export import chrome_trace_doc
+    spans = _spans()
+    assert (json.dumps(chrome_trace_doc(spans))
+            == json.dumps(chrome_trace_doc(list(reversed(spans)))))
+
+
+def test_merge_host_traces_prefixes_and_aligns():
+    from grace_tpu.profiling.trace_analysis import parse_chrome_trace
+    from grace_tpu.profiling.trace_export import (chrome_trace_doc,
+                                                  merge_host_traces)
+    spans = _spans()
+    # host1's clock starts 1e6 µs later; align rebases both to t=0.
+    shifted = [type(s)(name=s.name, ts=s.ts + 1e6, dur=s.dur,
+                       device=s.device, lane=s.lane, scope=s.scope)
+               for s in spans]
+    merged = merge_host_traces({"host0": spans, "host1": shifted})
+    assert len(merged) == 2 * len(spans)
+    devices = {s.device for s in merged}
+    assert "host0//device:TPU:0" in devices
+    assert "host1//device:TPU:1" in devices
+    by_host = {h: [s for s in merged if s.device.startswith(h + "/")]
+               for h in ("host0", "host1")}
+    assert min(s.ts for s in by_host["host0"]) == 0.0
+    assert min(s.ts for s in by_host["host1"]) == 0.0
+    # the merged timeline still round-trips through the parser
+    assert set(parse_chrome_trace(chrome_trace_doc(merged))) == set(merged)
+
+
+# ---------------------------------------------------------------------------
+# incident flight recorder
+
+
+def test_incident_recorder_triggers_debounces_and_ledgers(tmp_path):
+    out = str(tmp_path / "incidents")
+    led = str(tmp_path / "ledger.jsonl")
+    rec = incident.IncidentRecorder(
+        out, run_tag="t", min_gap_steps=10, ledger_path=led,
+        provenance={"platform": "cpu", "n_devices": 8})
+    with rec:
+        for step in range(5):
+            rec.write({"step": step, "metric": "wire_bytes", "value": 1.0})
+        rec.write({"step": 5, "event": "adapt_tighten", "rung": 2})
+        rec.write({"step": 7, "event": "guard_skip"})       # debounced
+        rec.attach_profile({"stages_ms": {"compress": 1.2}})
+        rec.write({"step": 30, "event": "guard_skip"})      # new incident
+    assert len(rec.incidents) == 2
+    first = json.load(open(rec.incidents[0]))
+    assert first["trigger"]["event"] == "adapt_tighten"
+    assert first["adapt_rungs"] and first["prof"] is None
+    assert len(first["telemetry_ring"]) == 6
+    assert first["watch_timeline"]["kind_counts"]
+    second = json.load(open(rec.incidents[1]))
+    assert second["prof"] == {"stages_ms": {"compress": 1.2}}
+    assert [r["event"] for r in second["guard_events"]] == ["guard_skip",
+                                                            "guard_skip"]
+    led_recs = ledger.load_ledger(led)
+    assert len(led_recs) == 2
+    assert all(r["tool"] == "flight_recorder" and
+               r["claim_class"] == "measured" for r in led_recs)
+    assert led_recs[1]["value"] == 30                # trigger step
+
+
+def test_incident_recorder_never_raises(tmp_path):
+    bad = incident.IncidentRecorder(
+        str(tmp_path / "nope"), ledger_path=str(tmp_path / "l.jsonl"))
+    bad.write("not-a-mapping")                       # swallowed, not raised
+    assert bad.incidents == []
+
+
+# ---------------------------------------------------------------------------
+# backfill + ledger-driven summary
+
+
+def test_backfill_is_idempotent(tmp_path):
+    led = str(tmp_path / "ledger.jsonl")
+    first = backfill_ledger(REPO, led)
+    assert first, "committed artifacts should mint records"
+    assert backfill_ledger(REPO, led) == []
+    ids = {r["id"] for r in first}
+    assert "bench-headline-tpu" in ids and "proj-topk1pct-xslice" in ids
+
+
+def test_evidence_summary_renders_ledger_extras(tmp_path, monkeypatch):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "evidence_summary_under_test",
+        os.path.join(REPO, "tools", "evidence_summary.py"))
+    ev = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ev)
+    monkeypatch.setattr(ev, "ROOT", str(tmp_path))
+
+    cap = tmp_path / "NEWTOOL_LAST.json"
+    cap.write_text('{"ok": true}\n')
+    led = tmp_path / "EVIDENCE" / "ledger.jsonl"
+    ledger.record_artifact(
+        str(cap), id="newtool-drill", metric="newtool_ok", value=True,
+        claim_class="measured", tool="newtool", platform="cpu",
+        n_devices=8, topology={"world": 8, "tiers": ["ici"]}, config=None,
+        lint_clean=None, ledger_path=str(led))
+    md = ev.build()
+    # no dedicated reader, yet it renders — straight from the ledger
+    assert "NEWTOOL_LAST.json" in md and "`newtool-drill`" in md
+    assert "no dedicated reader" in md

@@ -136,6 +136,64 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _evidence_stamp(world, slice_size=None, region_size=None) -> dict:
+    """Uniform provenance stamp for every chaos evidence doc — the same
+    n_devices/topology/git_rev triple bench rows carry (ISSUE 17: the
+    ADAPT/ELASTIC/REGION files used to ship with only a captured_at)."""
+    from grace_tpu.evidence.ledger import git_head_rev
+    tiers = ["ici"]
+    if slice_size:
+        tiers.append("dcn")
+    if region_size:
+        tiers.append("wan")
+    return {"git_rev": git_head_rev(),
+            "n_devices": world,
+            "topology": {"world": world, "tiers": tiers,
+                         "slice": slice_size or None,
+                         "region": region_size or None}}
+
+
+def _write_evidence_doc(doc: dict, out_path: str, *, ledger_id: str,
+                        metric: str, value, world: int,
+                        slice_size=None, region_size=None,
+                        label: str = "evidence") -> None:
+    """The one exit for chaos evidence docs: stamp provenance, write
+    atomically, append the ledger record (repo-root artifacts only, so a
+    test run against a tmp path never touches the ledger)."""
+    import json
+    doc = {**doc, **_evidence_stamp(world, slice_size, region_size)}
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, out_path)
+    print(f"[chaos_smoke] {label}: {out_path}")
+    if os.path.dirname(os.path.abspath(out_path)) != ROOT:
+        return
+    from grace_tpu.evidence.ledger import record_artifact
+    record_artifact(
+        out_path, id=ledger_id, metric=metric, value=value,
+        claim_class="measured", tool="chaos_smoke", platform="cpu",
+        chip="cpu", n_devices=world,
+        topology=doc["topology"], config=doc.get("argv"),
+        lint_clean=None, git_rev=doc["git_rev"])
+
+
+def _incident_sink(jsonl_sink, args, provenance, tag: str):
+    """Wrap the JSONL evidence sink with the flight recorder when
+    --incidents is set: same record stream, plus ledger-attached
+    incident snapshots on guard trips / adapt escalations / drains."""
+    if not getattr(args, "incidents", None) or jsonl_sink is None:
+        return jsonl_sink, None
+    from grace_tpu.evidence.incident import IncidentRecorder
+    from grace_tpu.telemetry import MultiSink
+    recorder = IncidentRecorder(args.incidents, run_tag=tag,
+                                provenance=provenance)
+    return MultiSink(jsonl_sink, recorder), recorder
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -154,6 +212,12 @@ def main(argv=None) -> int:
                     help="JSONL telemetry artifact path ('' disables)")
     ap.add_argument("--telemetry-every", type=int, default=25,
                     help="steps per telemetry flush (one device_get each)")
+    ap.add_argument("--incidents", default="",
+                    help="directory for flight-recorder incident "
+                         "snapshots ('' disables): guard trips, adapt "
+                         "escalations and drains each dump the telemetry "
+                         "ring + watch timeline + adapt rung history as "
+                         "a ledger-attached incident record")
     ap.add_argument("--sdc", action="store_true",
                     help="also inject single-rank param SDC (ChaosParams) "
                          "and require the consensus auditor to repair it")
@@ -423,14 +487,17 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     if args.telemetry_out:
-        sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+        prov = run_provenance(
             data="synthetic",
             tool="chaos_smoke",
             argv=" ".join(sys.argv[1:]),
             nan_prob=args.nan_prob, steps=args.steps,
             fallback_after=args.fallback_after,
             fallback_steps=args.fallback_steps,
-            homo=bool(args.homo)))
+            homo=bool(args.homo))
+        sink = JSONLSink(args.telemetry_out, provenance=prov)
+        sink, _ = _incident_sink(sink, args, prov,
+                                 "watch" if args.watch else "nan")
         reader = TelemetryReader(sink, every=args.telemetry_every,
                                  anomaly=args.watch)
     monitor = GuardMonitor(sink=sink)
@@ -712,11 +779,13 @@ def _fsdp_main(args) -> int:
 
     sink = reader = None
     if args.telemetry_out:
-        sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+        prov = run_provenance(
             data="synthetic", tool="chaos_smoke",
             argv=" ".join(sys.argv[1:]),
             nan_prob=args.nan_prob, steps=args.steps,
-            fsdp=fsdp, dp=dp))
+            fsdp=fsdp, dp=dp)
+        sink = JSONLSink(args.telemetry_out, provenance=prov)
+        sink, _ = _incident_sink(sink, args, prov, "fsdp")
         reader = TelemetryReader(sink, every=args.telemetry_every)
     monitor = GuardMonitor(sink=sink)
     consensus_mon = ConsensusMonitor(sink=sink)
@@ -943,10 +1012,12 @@ def _adapt_main(args) -> int:
               "acceptance artifact IS the adapt_tighten/guard event "
               "ordering", file=sys.stderr)
         return 1
-    sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+    prov = run_provenance(
         data="synthetic", tool="chaos_smoke",
         argv=" ".join(sys.argv[1:]), steps=args.steps,
-        adapt=True, adapt_window=window, adapt_rank=args.adapt_rank))
+        adapt=True, adapt_window=window, adapt_rank=args.adapt_rank)
+    sink = JSONLSink(args.telemetry_out, provenance=prov)
+    sink, _ = _incident_sink(sink, args, prov, "adapt")
     reader = TelemetryReader(sink, every=args.telemetry_every)
     adapt_mon = AdaptMonitor(sink=sink)
     monitor = GuardMonitor(sink=sink)
@@ -1072,12 +1143,11 @@ def _adapt_main(args) -> int:
             "guard_skips": int(guard_c["notfinite_count"]),
             "final_loss": float(total),
         }
-        tmp = args.adapt_out + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, args.adapt_out)
-        print(f"[chaos_smoke] adapt evidence: {args.adapt_out}")
+        _write_evidence_doc(doc, args.adapt_out,
+                            ledger_id="adapt-drill",
+                            metric="adapt_ordering_ok",
+                            value=bool(ordering_ok), world=world,
+                            label="adapt evidence")
 
     if not np.isfinite(total):
         print("[chaos_smoke] FAIL: final loss non-finite — the "
@@ -1201,10 +1271,12 @@ def _elastic_main(args) -> int:
     sink = None
     reader = None
     if args.telemetry_out:
-        sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+        prov = run_provenance(
             data="synthetic", tool="chaos_smoke",
             argv=" ".join(sys.argv[1:]), steps=args.steps,
-            elastic=True, elastic_rank=doomed, hier=args.hier))
+            elastic=True, elastic_rank=doomed, hier=args.hier)
+        sink = JSONLSink(args.telemetry_out, provenance=prov)
+        sink, _ = _incident_sink(sink, args, prov, "elastic")
         reader = TelemetryReader(sink, every=args.telemetry_every,
                                  anomaly=True)
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="grace_elastic_")
@@ -1373,12 +1445,13 @@ def _elastic_main(args) -> int:
                       "floor": args.floor, "met": bool(floor_met)},
             "footprint": {str(plan.new_world): fp_down, str(world): fp_up},
         }
-        tmp = args.elastic_out + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, args.elastic_out)
-        print(f"[chaos_smoke] elastic evidence: {args.elastic_out}")
+        _write_evidence_doc(doc, args.elastic_out,
+                            ledger_id="elastic-drill",
+                            metric="elastic_floor_met",
+                            value=bool(floor_met), world=world,
+                            slice_size=(args.slice_size if args.hier
+                                        else None),
+                            label="elastic evidence")
 
     if not np.isfinite(loss_c):
         print("[chaos_smoke] FAIL: final loss non-finite after the rejoin",
@@ -1502,10 +1575,12 @@ def _region_main(args) -> int:
     sink = None
     reader = None
     if args.telemetry_out:
-        sink = JSONLSink(args.telemetry_out, provenance=run_provenance(
+        prov = run_provenance(
             data="synthetic", tool="chaos_smoke",
             argv=" ".join(sys.argv[1:]), steps=args.steps,
-            region=True, region_size=rz, slice_size=s))
+            region=True, region_size=rz, slice_size=s)
+        sink = JSONLSink(args.telemetry_out, provenance=prov)
+        sink, _ = _incident_sink(sink, args, prov, "region")
         reader = TelemetryReader(sink, every=args.telemetry_every,
                                  anomaly=True)
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="grace_region_")
@@ -1712,12 +1787,12 @@ def _region_main(args) -> int:
                           str(world): fp_up},
             "guard_silent": guard_a["notfinite_count"] == 0,
         }
-        tmp = args.region_out + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, args.region_out)
-        print(f"[chaos_smoke] region evidence: {args.region_out}")
+        _write_evidence_doc(doc, args.region_out,
+                            ledger_id="region-drill",
+                            metric="region_floor_met",
+                            value=bool(floor_met), world=world,
+                            slice_size=s, region_size=rz,
+                            label="region evidence")
 
     if not np.isfinite(loss_c):
         print("[chaos_smoke] FAIL: final loss non-finite after the rejoin",

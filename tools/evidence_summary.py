@@ -1,13 +1,24 @@
-"""Render the repo's benchmark evidence files as one markdown summary.
+"""Render the repo's benchmark evidence as one markdown summary.
 
-Reads (all repo-root, all optional — missing files are skipped):
+Ledger-driven since graft-evidence: the enumeration authority is
+``EVIDENCE/ledger.jsonl`` (``grace_tpu.evidence``) — every capture a
+writer has attested shows up here, keyed by its ledger records. Captures
+with a dedicated reader below render as rich tables/prose (annotated
+with the ledger ids README claim markers cite); captures *without* one
+fall through to a generic ledger table, so a ``REGION_LAST.json``-style
+new artifact stops requiring per-file reader code the day its writer
+lands. Flight-recorder incidents get a one-line roll-up. Known artifacts
+found on disk still render even before their first ledger record, so a
+fresh checkout (or a test tmp dir) degrades to the pre-ledger behavior.
+
+Dedicated readers exist for (all repo-root, all optional):
   BENCH_TPU_LAST.json      headline dense-vs-compressed pair (TPU)
   BENCH_ALL_TPU_LAST.json  per-algorithm TPU sweep
+  BENCH_BERT_TPU_LAST.json BERT-base + PowerSGD rows
   BENCH_ALL_CPU.json       per-algorithm CPU-mesh smoke sweep
   TPU_VARIANTS.jsonl       selection-variant session rows
-  ELASTIC_LAST.json        chaos_smoke --elastic resize/rejoin evidence
-  ADAPT_LAST.json          chaos_smoke --adapt controller evidence
-                           (tighten-before-guard ordering, loosen counts)
+  LINT_LAST.json / PROF_LAST.json / ELASTIC_LAST.json /
+  REGION_LAST.json / ADAPT_LAST.json / WATCH_LAST.json / TUNE_LAST.json
 
 Usage: python tools/evidence_summary.py [--update-readme]
 Prints markdown to stdout; --update-readme splices it between the
@@ -28,7 +39,8 @@ BEGIN, END = "<!-- evidence:begin -->", "<!-- evidence:end -->"
 
 def _staleness(doc):
     """bench.evidence_staleness — the ONE stale-evidence detector, shared
-    with the bench's own last_tpu carry-along readers."""
+    with the bench's own last_tpu carry-along readers (itself a delegate
+    to grace_tpu.evidence.staleness since graft-evidence)."""
     import bench
     return bench.evidence_staleness(doc)
 
@@ -67,6 +79,37 @@ def _load(name):
             except json.JSONDecodeError:
                 return None
         return rows or None
+
+
+def _ledger_view():
+    """(by_capture_basename, latest_by_id) over the repo ledger — empty
+    dicts when no ledger exists (fresh checkout, test tmp dirs)."""
+    path = os.path.join(ROOT, "EVIDENCE", "ledger.jsonl")
+    try:
+        from grace_tpu.evidence.ledger import latest_by_id, load_ledger
+        latest = latest_by_id(load_ledger(path))
+    except Exception:                                      # noqa: BLE001
+        return {}, {}
+    by_capture = {}
+    for rec in latest.values():
+        base = os.path.basename(str(rec.get("capture") or ""))
+        if base:
+            by_capture.setdefault(base, []).append(rec)
+    for recs in by_capture.values():
+        recs.sort(key=lambda r: (r.get("claim_class") or "",
+                                 r.get("id") or ""))
+    return by_capture, latest
+
+
+def _ledger_note(recs):
+    """One sub-line tying a rendered section to its ledger records — the
+    same ids the README/CHANGELOG claim markers cite and graft_gate
+    verifies."""
+    if not recs:
+        return []
+    cite = ", ".join(f"`{r.get('id')}` [{r.get('claim_class', '?')}]"
+                     for r in recs)
+    return [f"<sub>ledger: {cite}</sub>"]
 
 
 def _fmt(x, nd=2):
@@ -179,311 +222,444 @@ def _curve_table():
     return out
 
 
-def build() -> str:
-    parts = []
-    head = _load("BENCH_TPU_LAST.json")
+# ---------------------------------------------------------------------------
+# Per-capture readers. Each takes the memoizing loader and returns the
+# section's lines ([] = skip). The _SECTIONS table below is the dispatch:
+# a capture basename listed there renders rich; anything else the ledger
+# names renders through _generic_section.
+
+def _sec_headline(docs):
+    head = docs("BENCH_TPU_LAST.json")
+    if not (head and head.get("rows")):
+        return []
+    cap = head.get("captured_at", "?")
+    chip = head.get("chip", "?")
+    partial = " (PARTIAL)" if head.get("partial") else ""
+    suffix, trailer = _stale_parts(head)
+    return _row_table(
+        head["rows"],
+        f"TPU headline ({chip}, captured {cap}){partial}{suffix}") + trailer
+
+
+def _sec_sweep(docs):
+    sweep = docs("BENCH_ALL_TPU_LAST.json")
+    if not (sweep and sweep.get("rows")):
+        return []
+    cap = sweep.get("captured_at", "?")
+    partial = " (PARTIAL)" if sweep.get("partial") else ""
+    suffix, trailer = _stale_parts(sweep)
+    parts = _row_table(
+        sweep["rows"], f"TPU per-algorithm sweep (captured {cap})"
+        + partial + suffix)
+    parts += trailer
+    # Same-named rows measured under different stamped params (e.g. the
+    # round-5 headline moving to per-leaf after the sweep captured the
+    # fused pair) read as contradictions without a caveat.
+    head = docs("BENCH_TPU_LAST.json")
     if head and head.get("rows"):
-        cap = head.get("captured_at", "?")
-        chip = head.get("chip", "?")
-        partial = " (PARTIAL)" if head.get("partial") else ""
-        suffix, trailer = _stale_parts(head)
-        parts += _row_table(
-            head["rows"],
-            f"TPU headline ({chip}, captured {cap}){partial}{suffix}")
-        parts += trailer
-        parts.append("")
-    sweep = _load("BENCH_ALL_TPU_LAST.json")
-    if sweep and sweep.get("rows"):
-        cap = sweep.get("captured_at", "?")
-        partial = " (PARTIAL)" if sweep.get("partial") else ""
-        suffix, trailer = _stale_parts(sweep)
-        parts += _row_table(
-            sweep["rows"], f"TPU per-algorithm sweep (captured {cap})"
-            + partial + suffix)
-        parts += trailer
-        # Same-named rows measured under different stamped params (e.g. the
-        # round-5 headline moving to per-leaf after the sweep captured the
-        # fused pair) read as contradictions without a caveat.
-        if head and head.get("rows"):
-            hp = {r["config"]: r.get("grace_params") for r in head["rows"]
-                  if r.get("grace_params")}
-            drift = [r["config"] for r in sweep["rows"]
-                     if r.get("grace_params") and
-                     hp.get(r.get("config")) not in (None,
-                                                     r["grace_params"])]
-            if drift:
-                parts += ["", "Note: " + ", ".join(sorted(set(drift))) +
-                          " above were captured under different params than "
-                          "the same-named headline rows (each row stamps its "
-                          "own `grace_params`; the headline is the "
-                          "authoritative config)."]
-        parts.append("")
-    variants = _load("TPU_VARIANTS.jsonl")
-    if variants:
-        parts += _row_table(
-            variants,
-            "Top-K selection variants (TPU) — SUPERSEDED: cross-session "
-            "ratios (the dense row here hit the tunnel-RTT trap); the "
-            "same-session sweep above is the quotable record")
-        parts.append("")
-    bert = _load("BENCH_BERT_TPU_LAST.json")
-    if bert and bert.get("rows"):
-        cap = bert.get("captured_at", "?")
-        partial = " (PARTIAL)" if bert.get("partial") else ""
-        parts += _row_table(
-            bert["rows"], f"BERT-base + PowerSGD r4 (captured {cap})"
-            + partial, value_key="tokens_per_sec", value_head="tokens/sec")
-        parts.append("")
-    rec = _load("BENCH_TPU_LAST.json") or {}
+        hp = {r["config"]: r.get("grace_params") for r in head["rows"]
+              if r.get("grace_params")}
+        drift = [r["config"] for r in sweep["rows"]
+                 if r.get("grace_params") and
+                 hp.get(r.get("config")) not in (None,
+                                                 r["grace_params"])]
+        if drift:
+            parts += ["", "Note: " + ", ".join(sorted(set(drift))) +
+                      " above were captured under different params than "
+                      "the same-named headline rows (each row stamps its "
+                      "own `grace_params`; the headline is the "
+                      "authoritative config)."]
+    return parts
+
+
+def _sec_variants(docs):
+    variants = docs("TPU_VARIANTS.jsonl")
+    if not variants:
+        return []
+    return _row_table(
+        variants,
+        "Top-K selection variants (TPU) — SUPERSEDED: cross-session "
+        "ratios (the dense row here hit the tunnel-RTT trap); the "
+        "same-session sweep above is the quotable record")
+
+
+def _sec_bert(docs):
+    bert = docs("BENCH_BERT_TPU_LAST.json")
+    if not (bert and bert.get("rows")):
+        return []
+    cap = bert.get("captured_at", "?")
+    partial = " (PARTIAL)" if bert.get("partial") else ""
+    return _row_table(
+        bert["rows"], f"BERT-base + PowerSGD r4 (captured {cap})"
+        + partial, value_key="tokens_per_sec", value_head="tokens/sec")
+
+
+def _sec_projection(docs):
+    rec = docs("BENCH_TPU_LAST.json") or {}
     proj = next((r["projection"] for r in rec.get("rows", [])
                  if r.get("config") == "topk1pct" and r.get("projection")),
                 None)
-    if proj:
-        parts += ["**Projected multi-chip speedup vs dense (topk1pct, "
-                  "analytic wire model over measured single-chip step)**", "",
-                  "| world | recv bytes/rank | step ms (ICI) | speedup ICI "
-                  "| speedup DCN |", "|---|---|---|---|---|"]
-        for p in proj:
-            parts.append(f"| {p['world']} | {p['recv_bytes_per_rank']:,} | "
-                         f"{p['step_ms_ici']} | "
-                         f"{p['speedup_vs_dense_ici']} | "
-                         f"{p['speedup_vs_dense_dcn']} |")
+    if not proj:
+        return []
+    parts = ["**Projected multi-chip speedup vs dense (topk1pct, "
+             "analytic wire model over measured single-chip step)**", "",
+             "| world | recv bytes/rank | step ms (ICI) | speedup ICI "
+             "| speedup DCN |", "|---|---|---|---|---|"]
+    for p in proj:
+        parts.append(f"| {p['world']} | {p['recv_bytes_per_rank']:,} | "
+                     f"{p['step_ms_ici']} | "
+                     f"{p['speedup_vs_dense_ici']} | "
+                     f"{p['speedup_vs_dense_dcn']} |")
+    return parts
+
+
+def _sec_cpu(docs):
+    cpu = docs("BENCH_ALL_CPU.json")
+    if not isinstance(cpu, list):
+        return []
+    data_rows = [r for r in cpu
+                 if r.get("config") and r.get("imgs_per_sec")]
+    skipped = [r["config"] for r in cpu if r.get("skipped")]
+    if not data_rows:
+        return []
+    skip_s = (f"; skipped on cpu: {', '.join(skipped)}"
+              if skipped else "")
+    return [f"CPU-mesh smoke sweep: {len(data_rows)} configs measured "
+            "in `BENCH_ALL_CPU.json` (throughput ratios are host-bound "
+            f"artifacts; the wire columns are the content{skip_s})."]
+
+
+def _sec_lint(docs):
+    lint = docs("LINT_LAST.json")
+    if not (isinstance(lint, dict) and "errors" in lint):
+        return []
+    when = (lint.get("captured_at") or "").split("T")[0]
+    counts = lint.get("pass_counts") or {}
+    if counts:
+        dirty = {p: n for p, n in counts.items() if n}
+        per_pass = (f"; per-pass findings: "
+                    + ", ".join(f"{p} {n}"
+                                for p, n in sorted(dirty.items()))
+                    if dirty else
+                    f"; all {len(counts)} passes clean")
+    else:
+        per_pass = ""
+    bounds = lint.get("overlap_bounds") or {}
+    bound_s = ""
+    if bounds:
+        bound_s = ("; bucketed overlap bounds: " + ", ".join(
+            f"{name} static≤{rep.get('static_overlap_bound')} "
+            f"({rep.get('independent_chains')}/"
+            f"{rep.get('expected_chains')} chains)"
+            for name, rep in sorted(bounds.items())
+            if isinstance(rep, dict) and "error" not in rep))
+    return [
+        f"Static analysis: `graft_lint --all-configs` → "
+        f"{lint['errors']} error(s) / {lint.get('warnings', 0)} "
+        f"warning(s) over {lint.get('configs_audited', '?')} configs + "
+        f"{lint.get('rules_checked', '?')} repo rules"
+        f"{per_pass}{bound_s} "
+        f"(`LINT_LAST.json`{', ' + when if when else ''})."]
+
+
+def _sec_prof(docs):
+    prof = docs("PROF_LAST.json")
+    if not (isinstance(prof, dict) and prof.get("stages_ms")):
+        return []
+    when = (prof.get("captured_at") or "").split("T")[0]
+    top = max(prof["stages_ms"].items(), key=lambda kv: kv[1])
+    ov = prof.get("overlap_fraction")
+    steps = prof.get("step_times") or {}
+    bits = [f"total device time {_fmt(prof.get('total_device_ms'), 3)} "
+            f"ms, top stage {top[0]} ({_fmt(top[1], 3)} ms)"]
+    if ov is not None:
+        bits.append(f"overlap fraction {100.0 * ov:.1f}%")
+    sand = prof.get("overlap_sandwich")
+    if isinstance(sand, dict):
+        verdict = ("VIOLATED" if sand.get("violations") else "holds")
+        bits.append(
+            f"measured≤static sandwich vs {sand.get('config')} "
+            f"(bound {sand.get('static_overlap_bound')}): {verdict}")
+    if steps.get("p50_ms") is not None:
+        bits.append(f"step p50 {_fmt(steps['p50_ms'], 3)} ms")
+    regr = prof.get("regressions")
+    if regr is not None:
+        bits.append(f"{len(regr)} baseline regression(s)")
+    note = f" — {prof['note']}" if prof.get("note") else ""
+    return [
+        f"Performance attribution: `perf_report --trace "
+        f"{prof.get('trace', '?')}` → " + ", ".join(bits) +
+        f" (`PROF_LAST.json`{', ' + when if when else ''}){note}."]
+
+
+def _sec_elastic(docs):
+    elastic = docs("ELASTIC_LAST.json")
+    if not (isinstance(elastic, dict)
+            and elastic.get("tool") == "chaos_smoke"):
+        return []
+    when = (elastic.get("captured_at") or "").split("T")[0]
+    cycle = " → ".join(str(w) for w in (elastic.get("world_cycle") or []))
+    resizes = elastic.get("resize_events") or []
+    rejoin = elastic.get("rejoin") or {}
+    floor = elastic.get("floor") or {}
+    fp = elastic.get("footprint") or {}
+    bits = [f"world cycle {cycle}" if cycle else "no resize recorded",
+            f"{len(resizes)} resize event(s)"]
+    if rejoin:
+        verdict = ("bit-identical" if rejoin.get("replica_variants") == 1
+                   else f"{rejoin.get('replica_variants')} variants")
+        bits.append(
+            f"rejoin barrier: {rejoin.get('barrier_repairs', '?')} "
+            f"repair(s) for {rejoin.get('rejoins', '?')} rejoin(s), "
+            f"replicas {verdict} "
+            f"(fingerprint {rejoin.get('fingerprint_bytes', '?')} B)")
+    if floor:
+        met = "met" if floor.get("met") else "MISSED"
+        bits.append(f"convergence floor {met} "
+                    f"(final loss {_fmt(floor.get('final_loss'), 4)} vs "
+                    f"floor {_fmt(floor.get('floor'), 2)})")
+    if fp:
+        ok = all(bool(v) for v in fp.values())
+        bits.append("re-shard footprint vs flow pass 7 model: "
+                    + ("matches at "
+                       + ", ".join(f"W={k}" for k in sorted(fp))
+                       if ok else f"MISMATCH {fp}"))
+    return [
+        "Elastic training (graft-elastic): `chaos_smoke --elastic` → "
+        + ", ".join(bits)
+        + f" (`ELASTIC_LAST.json`{', ' + when if when else ''})."]
+
+
+def _sec_region(docs):
+    region = docs("REGION_LAST.json")
+    if not (isinstance(region, dict)
+            and region.get("tool") == "chaos_smoke"):
+        return []
+    when = (region.get("captured_at") or "").split("T")[0]
+    cycle = " → ".join(str(w) for w in (region.get("world_cycle") or []))
+    drain = region.get("drain") or {}
+    rejoin = region.get("rejoin") or {}
+    floor = region.get("floor") or {}
+    fp = region.get("footprint") or {}
+    layout = (f"{region.get('regions', '?')} regions × "
+              f"region {region.get('region_size', '?')} / "
+              f"slice {region.get('slice_size', '?')}")
+    bits = [f"world cycle {cycle} ({layout})"]
+    if drain:
+        scoped = ("region-wide" if drain.get("region_wide")
+                  else f"PARTIAL scope {drain.get('scope')}")
+        bits.append(
+            f"{drain.get('transitions', '?')} drain transition(s) for "
+            f"drift on ranks {region.get('drift_ranks')} — {scoped}, "
+            f"{drain.get('drain_timeouts', 0)} watchdog timeout(s)")
+    if rejoin:
+        verdict = ("bit-identical" if rejoin.get("replica_variants") == 1
+                   else f"{rejoin.get('replica_variants')} variants")
+        bits.append(
+            f"region rejoin barrier: {rejoin.get('barrier_repairs', '?')}"
+            f" repair(s) for {rejoin.get('rejoins', '?')} region "
+            f"rejoin(s) ({rejoin.get('rejoined_ranks', '?')} ranks), "
+            f"replicas {verdict}")
+    if floor:
+        met = "met" if floor.get("met") else "MISSED"
+        bits.append(f"convergence floor {met} "
+                    f"(final loss {_fmt(floor.get('final_loss'), 4)} vs "
+                    f"floor {_fmt(floor.get('floor'), 2)})")
+    if fp:
+        ok = all(bool(v) for v in fp.values())
+        bits.append("re-shard footprint vs flow pass 7 model: "
+                    + ("matches at "
+                       + ", ".join(f"W={k}" for k in sorted(fp))
+                       if ok else f"MISMATCH {fp}"))
+    if region.get("guard_silent") is not None:
+        bits.append("guard "
+                    + ("silent through the drift phase"
+                       if region.get("guard_silent") else "TRIPPED"))
+    return [
+        "Cross-region elasticity (graft-region): `chaos_smoke "
+        "--region` → " + ", ".join(bits)
+        + f" (`REGION_LAST.json`{', ' + when if when else ''})."]
+
+
+def _sec_adapt(docs):
+    adapt = docs("ADAPT_LAST.json")
+    if not (isinstance(adapt, dict)
+            and adapt.get("tool") == "chaos_smoke"):
+        return []
+    when = (adapt.get("captured_at") or "").split("T")[0]
+    ti = adapt.get("tighten") or {}
+    lo = adapt.get("loosen") or {}
+    within = "within one window" if ti.get("within_one_window") \
+        else "LATE (outside one window)"
+    order = ("adapt_tighten precedes the first guard event"
+             if adapt.get("ordering_ok")
+             else "ORDERING VIOLATED (guard fired first)")
+    bits = [
+        f"{len(adapt.get('ladder') or [])}-rung ladder, window "
+        f"{adapt.get('window', '?')} steps",
+        f"drift → {ti.get('count', '?')} tighten(s), first at step "
+        f"{ti.get('first_step', '?')} ({within})",
+        f"quiet → {lo.get('count', '?')} loosen(s)",
+        f"NaN → {adapt.get('guard_skips', '?')} guard skip(s), "
+        f"{adapt.get('escalations', '?')} escalate-and-hold(s)",
+        order,
+    ]
+    return [
+        "Adaptive compression (graft-adapt): `chaos_smoke --adapt` → "
+        + ", ".join(bits)
+        + f" (`ADAPT_LAST.json`{', ' + when if when else ''})."]
+
+
+def _sec_watch(docs):
+    watch = docs("WATCH_LAST.json")
+    if not (isinstance(watch, dict)
+            and watch.get("tool") == "graft_watch"):
+        return []
+    when = (watch.get("captured_at") or "").split("T")[0]
+    counts = watch.get("kind_counts") or {}
+    bits = [f"{watch.get('events', '?')} events "
+            f"({', '.join(f'{k} {v}' for k, v in sorted(counts.items()))})",
+            f"{watch.get('anomalies', 0)} anomaly record(s)"]
+    ranks = watch.get("anomalous_ranks")
+    if ranks:
+        bits.append(f"anomalous rank(s) {ranks} first flagged at step "
+                    f"{watch.get('first_anomaly_step')}")
+    regr = watch.get("regressions")
+    if regr is not None:
+        bits.append(f"{len(regr)} baseline regression(s)")
+    note = (" — seeded single-rank drift scenario, not a healthy run"
+            if ranks else "")
+    return [
+        f"Run health (graft-watch): `graft_watch "
+        f"{watch.get('artifact', '?')}` → " + ", ".join(bits) +
+        f" (`WATCH_LAST.json`{', ' + when if when else ''}){note}."]
+
+
+def _sec_tune(docs):
+    tune = docs("TUNE_LAST.json")
+    if not (isinstance(tune, dict) and tune.get("tool") == "graft_tune"):
+        return []
+    when = (tune.get("captured_at") or "").split("T")[0]
+    bits = []
+    for label, st in sorted((tune.get("static") or {}).items()):
+        c = st.get("counts") or {}
+        top = (st.get("ranking") or [{}])[0].get("candidate", "?")
+        bits.append(
+            f"{label}: {c.get('enumerated', '?')} enumerated → "
+            f"{c.get('capability_rejected', 0)} capability / "
+            f"{c.get('numeric_rejected', 0)} numeric / "
+            f"{c.get('degradation_rejected', 0)} degradation rejected "
+            f"→ {c.get('shortlisted', 0)} shortlisted, "
+            f"top static pick `{top}`")
+    w = tune.get("winner")
+    if w:
+        s = w.get("overlap_sandwich") or {}
+        m = w.get("measured") or {}
+        verdict = "holds" if s.get("holds") else "VIOLATED"
+        bits.append(
+            f"winner `{w.get('candidate')}` at {tune.get('target')} "
+            f"(measured step {m.get('measured_step_ms', '?')} ms, "
+            f"×{m.get('measured_speedup_vs_dense', '?')} vs dense "
+            f"same-session; measured≤static overlap sandwich "
+            f"{s.get('measured_overlap')}≤"
+            f"{s.get('static_overlap_bound')}: {verdict}) — load with "
+            f"`grace_from_params(TUNE_LAST.winner.grace_params)`")
+    elif tune.get("static_only"):
+        bits.append("static-only survey (no measured winner stamped)")
+    platform = (tune.get("provenance") or {}).get("platform")
+    note = (" — CPU-mesh pipeline evidence, not a chip capture"
+            if platform and platform != "tpu" else "")
+    return [
+        "Autotuning (graft-tune): `graft_tune` → " + "; ".join(bits)
+        + f" (`TUNE_LAST.json`{', ' + when if when else ''}){note}."]
+
+
+# Dispatch: capture basename → dedicated reader, in render order. The
+# None-keyed entries are views, not captures of their own (the projection
+# table reads the headline doc; curve TSVs self-describe).
+_SECTIONS = (
+    ("BENCH_TPU_LAST.json", _sec_headline),
+    ("BENCH_ALL_TPU_LAST.json", _sec_sweep),
+    ("TPU_VARIANTS.jsonl", _sec_variants),
+    ("BENCH_BERT_TPU_LAST.json", _sec_bert),
+    (None, _sec_projection),
+    (None, lambda docs: _curve_table()),
+    ("BENCH_ALL_CPU.json", _sec_cpu),
+    ("LINT_LAST.json", _sec_lint),
+    ("PROF_LAST.json", _sec_prof),
+    ("ELASTIC_LAST.json", _sec_elastic),
+    ("REGION_LAST.json", _sec_region),
+    ("ADAPT_LAST.json", _sec_adapt),
+    ("WATCH_LAST.json", _sec_watch),
+    ("TUNE_LAST.json", _sec_tune),
+)
+
+
+def _generic_section(base, recs):
+    """Ledger-driven fallback: a capture attested in the ledger but with
+    no dedicated reader above still renders — ids, metric, claim class
+    and provenance straight from its records."""
+    out = [f"**`{base}`** (from the evidence ledger — no dedicated "
+           "reader)", "",
+           "| ledger id | metric | value | class | platform | devices |"
+           " captured |", "|---|---|---|---|---|---|---|"]
+    for r in recs:
+        when = (r.get("timestamp") or "").split("T")[0]
+        out.append(
+            f"| `{r.get('id')}` | {r.get('metric', '?')} | "
+            f"{r.get('value')} | {r.get('claim_class', '?')} | "
+            f"{r.get('platform') or '—'} | {r.get('n_devices') or '—'} | "
+            f"{when or '—'} |")
+    return out
+
+
+def _incident_rollup(latest):
+    """Flight-recorder roll-up: ledger records minted by the incident
+    recorder plus whatever sits under EVIDENCE/incidents/."""
+    import glob
+    incs = [r for r in latest.values()
+            if r.get("tool") == "flight_recorder"]
+    files = glob.glob(os.path.join(ROOT, "EVIDENCE", "incidents",
+                                   "*.json"))
+    if not incs and not files:
+        return []
+    return [f"Flight recorder: {len(files)} incident record(s) under "
+            f"`EVIDENCE/incidents/` ({len(incs)} ledger-attached) — each "
+            "snapshots the telemetry ring, watch timeline, adapt rung "
+            "history and profiler attribution at its trigger step."]
+
+
+def build() -> str:
+    cache = {}
+
+    def docs(name):
+        if name not in cache:
+            cache[name] = _load(name)
+        return cache[name]
+
+    by_capture, latest = _ledger_view()
+    parts = []
+    covered = set()
+    for base, render in _SECTIONS:
+        if base is not None:
+            covered.add(base)
+        lines = render(docs)
+        if not lines:
+            continue
+        parts += lines
+        if base is not None:
+            parts += _ledger_note(by_capture.get(base) or [])
         parts.append("")
-    curves = _curve_table()
-    if curves:
-        parts += curves
+    # Ledger captures nobody above reads: generic render. Incident
+    # records roll up as one line rather than one section per file.
+    extras = sorted(base for base, recs in by_capture.items()
+                    if base not in covered
+                    and not all(r.get("tool") == "flight_recorder"
+                                for r in recs))
+    for base in extras:
+        parts += _generic_section(base, by_capture[base])
         parts.append("")
-    cpu = _load("BENCH_ALL_CPU.json")
-    if isinstance(cpu, list):
-        data_rows = [r for r in cpu
-                     if r.get("config") and r.get("imgs_per_sec")]
-        skipped = [r["config"] for r in cpu if r.get("skipped")]
-        if data_rows:
-            skip_s = (f"; skipped on cpu: {', '.join(skipped)}"
-                      if skipped else "")
-            parts.append(
-                f"CPU-mesh smoke sweep: {len(data_rows)} configs measured "
-                "in `BENCH_ALL_CPU.json` (throughput ratios are host-bound "
-                f"artifacts; the wire columns are the content{skip_s}).")
-    lint = _load("LINT_LAST.json")
-    if isinstance(lint, dict) and "errors" in lint:
-        when = (lint.get("captured_at") or "").split("T")[0]
-        counts = lint.get("pass_counts") or {}
-        if counts:
-            dirty = {p: n for p, n in counts.items() if n}
-            per_pass = (f"; per-pass findings: "
-                        + ", ".join(f"{p} {n}"
-                                    for p, n in sorted(dirty.items()))
-                        if dirty else
-                        f"; all {len(counts)} passes clean")
-        else:
-            per_pass = ""
-        bounds = lint.get("overlap_bounds") or {}
-        bound_s = ""
-        if bounds:
-            bound_s = ("; bucketed overlap bounds: " + ", ".join(
-                f"{name} static≤{rep.get('static_overlap_bound')} "
-                f"({rep.get('independent_chains')}/"
-                f"{rep.get('expected_chains')} chains)"
-                for name, rep in sorted(bounds.items())
-                if isinstance(rep, dict) and "error" not in rep))
-        parts.append(
-            f"Static analysis: `graft_lint --all-configs` → "
-            f"{lint['errors']} error(s) / {lint.get('warnings', 0)} "
-            f"warning(s) over {lint.get('configs_audited', '?')} configs + "
-            f"{lint.get('rules_checked', '?')} repo rules"
-            f"{per_pass}{bound_s} "
-            f"(`LINT_LAST.json`{', ' + when if when else ''}).")
-    prof = _load("PROF_LAST.json")
-    if isinstance(prof, dict) and prof.get("stages_ms"):
-        when = (prof.get("captured_at") or "").split("T")[0]
-        top = max(prof["stages_ms"].items(), key=lambda kv: kv[1])
-        ov = prof.get("overlap_fraction")
-        steps = prof.get("step_times") or {}
-        bits = [f"total device time {_fmt(prof.get('total_device_ms'), 3)} "
-                f"ms, top stage {top[0]} ({_fmt(top[1], 3)} ms)"]
-        if ov is not None:
-            bits.append(f"overlap fraction {100.0 * ov:.1f}%")
-        sand = prof.get("overlap_sandwich")
-        if isinstance(sand, dict):
-            verdict = ("VIOLATED" if sand.get("violations") else "holds")
-            bits.append(
-                f"measured≤static sandwich vs {sand.get('config')} "
-                f"(bound {sand.get('static_overlap_bound')}): {verdict}")
-        if steps.get("p50_ms") is not None:
-            bits.append(f"step p50 {_fmt(steps['p50_ms'], 3)} ms")
-        regr = prof.get("regressions")
-        if regr is not None:
-            bits.append(f"{len(regr)} baseline regression(s)")
-        note = f" — {prof['note']}" if prof.get("note") else ""
-        parts.append("")
-        parts.append(
-            f"Performance attribution: `perf_report --trace "
-            f"{prof.get('trace', '?')}` → " + ", ".join(bits) +
-            f" (`PROF_LAST.json`{', ' + when if when else ''}){note}.")
-    elastic = _load("ELASTIC_LAST.json")
-    if isinstance(elastic, dict) and elastic.get("tool") == "chaos_smoke":
-        when = (elastic.get("captured_at") or "").split("T")[0]
-        cycle = " → ".join(str(w) for w in (elastic.get("world_cycle") or []))
-        resizes = elastic.get("resize_events") or []
-        rejoin = elastic.get("rejoin") or {}
-        floor = elastic.get("floor") or {}
-        fp = elastic.get("footprint") or {}
-        bits = [f"world cycle {cycle}" if cycle else "no resize recorded",
-                f"{len(resizes)} resize event(s)"]
-        if rejoin:
-            verdict = ("bit-identical" if rejoin.get("replica_variants") == 1
-                       else f"{rejoin.get('replica_variants')} variants")
-            bits.append(
-                f"rejoin barrier: {rejoin.get('barrier_repairs', '?')} "
-                f"repair(s) for {rejoin.get('rejoins', '?')} rejoin(s), "
-                f"replicas {verdict} "
-                f"(fingerprint {rejoin.get('fingerprint_bytes', '?')} B)")
-        if floor:
-            met = "met" if floor.get("met") else "MISSED"
-            bits.append(f"convergence floor {met} "
-                        f"(final loss {_fmt(floor.get('final_loss'), 4)} vs "
-                        f"floor {_fmt(floor.get('floor'), 2)})")
-        if fp:
-            ok = all(bool(v) for v in fp.values())
-            bits.append("re-shard footprint vs flow pass 7 model: "
-                        + ("matches at "
-                           + ", ".join(f"W={k}" for k in sorted(fp))
-                           if ok else f"MISMATCH {fp}"))
-        parts.append("")
-        parts.append(
-            "Elastic training (graft-elastic): `chaos_smoke --elastic` → "
-            + ", ".join(bits)
-            + f" (`ELASTIC_LAST.json`{', ' + when if when else ''}).")
-    region = _load("REGION_LAST.json")
-    if isinstance(region, dict) and region.get("tool") == "chaos_smoke":
-        when = (region.get("captured_at") or "").split("T")[0]
-        cycle = " → ".join(str(w) for w in (region.get("world_cycle") or []))
-        drain = region.get("drain") or {}
-        rejoin = region.get("rejoin") or {}
-        floor = region.get("floor") or {}
-        fp = region.get("footprint") or {}
-        layout = (f"{region.get('regions', '?')} regions × "
-                  f"region {region.get('region_size', '?')} / "
-                  f"slice {region.get('slice_size', '?')}")
-        bits = [f"world cycle {cycle} ({layout})"]
-        if drain:
-            scoped = ("region-wide" if drain.get("region_wide")
-                      else f"PARTIAL scope {drain.get('scope')}")
-            bits.append(
-                f"{drain.get('transitions', '?')} drain transition(s) for "
-                f"drift on ranks {region.get('drift_ranks')} — {scoped}, "
-                f"{drain.get('drain_timeouts', 0)} watchdog timeout(s)")
-        if rejoin:
-            verdict = ("bit-identical" if rejoin.get("replica_variants") == 1
-                       else f"{rejoin.get('replica_variants')} variants")
-            bits.append(
-                f"region rejoin barrier: {rejoin.get('barrier_repairs', '?')}"
-                f" repair(s) for {rejoin.get('rejoins', '?')} region "
-                f"rejoin(s) ({rejoin.get('rejoined_ranks', '?')} ranks), "
-                f"replicas {verdict}")
-        if floor:
-            met = "met" if floor.get("met") else "MISSED"
-            bits.append(f"convergence floor {met} "
-                        f"(final loss {_fmt(floor.get('final_loss'), 4)} vs "
-                        f"floor {_fmt(floor.get('floor'), 2)})")
-        if fp:
-            ok = all(bool(v) for v in fp.values())
-            bits.append("re-shard footprint vs flow pass 7 model: "
-                        + ("matches at "
-                           + ", ".join(f"W={k}" for k in sorted(fp))
-                           if ok else f"MISMATCH {fp}"))
-        if region.get("guard_silent") is not None:
-            bits.append("guard "
-                        + ("silent through the drift phase"
-                           if region.get("guard_silent") else "TRIPPED"))
-        parts.append("")
-        parts.append(
-            "Cross-region elasticity (graft-region): `chaos_smoke "
-            "--region` → " + ", ".join(bits)
-            + f" (`REGION_LAST.json`{', ' + when if when else ''}).")
-    adapt = _load("ADAPT_LAST.json")
-    if isinstance(adapt, dict) and adapt.get("tool") == "chaos_smoke":
-        when = (adapt.get("captured_at") or "").split("T")[0]
-        ti = adapt.get("tighten") or {}
-        lo = adapt.get("loosen") or {}
-        within = "within one window" if ti.get("within_one_window") \
-            else "LATE (outside one window)"
-        order = ("adapt_tighten precedes the first guard event"
-                 if adapt.get("ordering_ok")
-                 else "ORDERING VIOLATED (guard fired first)")
-        bits = [
-            f"{len(adapt.get('ladder') or [])}-rung ladder, window "
-            f"{adapt.get('window', '?')} steps",
-            f"drift → {ti.get('count', '?')} tighten(s), first at step "
-            f"{ti.get('first_step', '?')} ({within})",
-            f"quiet → {lo.get('count', '?')} loosen(s)",
-            f"NaN → {adapt.get('guard_skips', '?')} guard skip(s), "
-            f"{adapt.get('escalations', '?')} escalate-and-hold(s)",
-            order,
-        ]
-        parts.append("")
-        parts.append(
-            "Adaptive compression (graft-adapt): `chaos_smoke --adapt` → "
-            + ", ".join(bits)
-            + f" (`ADAPT_LAST.json`{', ' + when if when else ''}).")
-    watch = _load("WATCH_LAST.json")
-    if isinstance(watch, dict) and watch.get("tool") == "graft_watch":
-        when = (watch.get("captured_at") or "").split("T")[0]
-        counts = watch.get("kind_counts") or {}
-        bits = [f"{watch.get('events', '?')} events "
-                f"({', '.join(f'{k} {v}' for k, v in sorted(counts.items()))})",
-                f"{watch.get('anomalies', 0)} anomaly record(s)"]
-        ranks = watch.get("anomalous_ranks")
-        if ranks:
-            bits.append(f"anomalous rank(s) {ranks} first flagged at step "
-                        f"{watch.get('first_anomaly_step')}")
-        regr = watch.get("regressions")
-        if regr is not None:
-            bits.append(f"{len(regr)} baseline regression(s)")
-        note = (" — seeded single-rank drift scenario, not a healthy run"
-                if ranks else "")
-        parts.append("")
-        parts.append(
-            f"Run health (graft-watch): `graft_watch "
-            f"{watch.get('artifact', '?')}` → " + ", ".join(bits) +
-            f" (`WATCH_LAST.json`{', ' + when if when else ''}){note}.")
-    tune = _load("TUNE_LAST.json")
-    if isinstance(tune, dict) and tune.get("tool") == "graft_tune":
-        when = (tune.get("captured_at") or "").split("T")[0]
-        bits = []
-        for label, st in sorted((tune.get("static") or {}).items()):
-            c = st.get("counts") or {}
-            top = (st.get("ranking") or [{}])[0].get("candidate", "?")
-            bits.append(
-                f"{label}: {c.get('enumerated', '?')} enumerated → "
-                f"{c.get('capability_rejected', 0)} capability / "
-                f"{c.get('numeric_rejected', 0)} numeric / "
-                f"{c.get('degradation_rejected', 0)} degradation rejected "
-                f"→ {c.get('shortlisted', 0)} shortlisted, "
-                f"top static pick `{top}`")
-        w = tune.get("winner")
-        if w:
-            s = w.get("overlap_sandwich") or {}
-            m = w.get("measured") or {}
-            verdict = "holds" if s.get("holds") else "VIOLATED"
-            bits.append(
-                f"winner `{w.get('candidate')}` at {tune.get('target')} "
-                f"(measured step {m.get('measured_step_ms', '?')} ms, "
-                f"×{m.get('measured_speedup_vs_dense', '?')} vs dense "
-                f"same-session; measured≤static overlap sandwich "
-                f"{s.get('measured_overlap')}≤"
-                f"{s.get('static_overlap_bound')}: {verdict}) — load with "
-                f"`grace_from_params(TUNE_LAST.winner.grace_params)`")
-        elif tune.get("static_only"):
-            bits.append("static-only survey (no measured winner stamped)")
-        platform = (tune.get("provenance") or {}).get("platform")
-        note = (" — CPU-mesh pipeline evidence, not a chip capture"
-                if platform and platform != "tpu" else "")
-        parts.append("")
-        parts.append(
-            "Autotuning (graft-tune): `graft_tune` → " + "; ".join(bits)
-            + f" (`TUNE_LAST.json`{', ' + when if when else ''}){note}.")
+    parts += _incident_rollup(latest)
     return "\n".join(parts).rstrip() + "\n"
 
 

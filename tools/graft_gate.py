@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""graft-gate: audit README/CHANGELOG claims against the evidence ledger.
+
+ROADMAP item 1's ``evidence_gate`` CI mode: every quantitative headline
+ratio in README.md/CHANGELOG.md must sit in a paragraph carrying a claim
+marker (``<!-- evidence: <ledger-id> -->``), and every cited ledger
+record must verify — capture file hash unchanged, provenance rev an
+ancestor of HEAD (``git merge-base --is-ancestor``), claim class
+consistent with the capture's device count. Verdicts render as
+MEASURED / PROJECTED / STALE badges.
+
+Usage:
+  python tools/graft_gate.py                 # report (exit 0 always)
+  python tools/graft_gate.py --ci            # exit 1 on unmarked claims
+                                             # or STALE citations
+  python tools/graft_gate.py --update-readme # splice the badge block
+  python tools/graft_gate.py --backfill      # mint ledger records from
+                                             # the committed artifacts
+  python tools/graft_gate.py --json          # machine-readable report
+
+Exit status: 0 gate passes (or report-only mode); 1 gate failures under
+--ci; 2 crashed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[1])
+    ap.add_argument("--ci", action="store_true",
+                    help="exit 1 on unmarked quantitative claims or "
+                         "STALE citations")
+    ap.add_argument("--update-readme", action="store_true",
+                    help="splice the MEASURED/PROJECTED/STALE badge "
+                         "block into README.md")
+    ap.add_argument("--backfill", action="store_true",
+                    help="mint ledger records for committed artifacts "
+                         "not yet in the ledger")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    ap.add_argument("--root", default=ROOT,
+                    help="repo root to audit (default: this repo)")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path (default: <root>/EVIDENCE/"
+                         "ledger.jsonl)")
+    args = ap.parse_args(argv)
+
+    from grace_tpu.evidence.backfill import backfill_ledger
+    from grace_tpu.evidence.gate import gate_report, splice_badges
+
+    if args.backfill:
+        appended = backfill_ledger(args.root, args.ledger, verbose=True)
+        print(f"[graft_gate] backfill appended {len(appended)} record(s)")
+
+    report = gate_report(args.root, args.ledger)
+
+    if args.update_readme:
+        changed = splice_badges(os.path.join(args.root, "README.md"),
+                                report)
+        print(f"[graft_gate] README badge block "
+              f"{'updated' if changed else 'unchanged'}")
+
+    if args.json:
+        slim = {
+            "ok": report["ok"],
+            "failures": report["failures"],
+            "records": {cid: {"status": r["status"],
+                              "failures": r["failures"],
+                              "notes": r["notes"]}
+                        for cid, r in report["records"].items()},
+            "claims": {doc: {"n_claims": len(scan["claims"]),
+                             "n_unmarked": len(scan["unmarked"])}
+                       for doc, scan in report["docs"].items()},
+        }
+        print(json.dumps(slim, indent=1))
+    else:
+        for doc, scan in sorted(report["docs"].items()):
+            print(f"[graft_gate] {doc}: {len(scan['claims'])} "
+                  f"quantitative claim line(s), "
+                  f"{len(scan['unmarked'])} unmarked")
+        for cid, res in sorted(report["records"].items()):
+            rec = res.get("record") or {}
+            print(f"  {res['status']:<9} {cid}  "
+                  f"[{rec.get('claim_class', '?')}] "
+                  f"{rec.get('metric', 'no-record')}")
+            for f in res["failures"]:
+                print(f"            ! {f}")
+        if report["failures"]:
+            print(f"[graft_gate] {len(report['failures'])} gate "
+                  f"failure(s):")
+            for f in report["failures"]:
+                print(f"  FAIL {f}")
+        else:
+            print("[graft_gate] gate clean: every claim marked, every "
+                  "citation verifies")
+
+    if args.ci and not report["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:                                 # noqa: BLE001
+        print(f"[graft_gate] crashed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        sys.exit(2)

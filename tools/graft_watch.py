@@ -200,11 +200,42 @@ def main(argv=None) -> int:
               file=sys.stderr)
 
     if args.out:
+        # Uniform provenance stamp (ISSUE 17): the watch doc carries the
+        # same n_devices/topology/git_rev triple as every other evidence
+        # writer, lifted from the artifact's own provenance header.
+        prov = timeline.provenance or {}
+        n_dev = prov.get("n_devices")
         try:
-            _atomic_write(args.out, {**doc, "captured_at": _now()})
+            from grace_tpu.evidence.ledger import git_head_rev
+            rev = git_head_rev()
+        except Exception:                                  # noqa: BLE001
+            rev = None
+        stamped = {**doc, "git_rev": rev, "n_devices": n_dev,
+                   "topology": ({"world": n_dev, "tiers": ["ici"],
+                                 "slice": None, "region": None}
+                                if n_dev else None),
+                   "captured_at": _now()}
+        try:
+            _atomic_write(args.out, stamped)
         except OSError as e:
             print(f"[graft_watch] could not save {args.out}: {e}",
                   file=sys.stderr)
+        else:
+            if os.path.dirname(os.path.abspath(args.out)) == ROOT:
+                try:
+                    from grace_tpu.evidence.ledger import record_artifact
+                    record_artifact(
+                        args.out, id="watch-drill",
+                        metric="watch_anomalies",
+                        value=doc.get("anomalies"),
+                        claim_class="measured", tool="graft_watch",
+                        platform=prov.get("platform"),
+                        chip=prov.get("device"), n_devices=n_dev,
+                        topology=stamped["topology"],
+                        config=args.path, lint_clean=None, git_rev=rev)
+                except Exception as e:                     # noqa: BLE001
+                    print(f"[graft_watch] ledger emission failed: {e}",
+                          file=sys.stderr)
 
     if args.json:
         print(json.dumps(doc, indent=1))

@@ -47,13 +47,31 @@ def main() -> None:
              else list(VARIANTS))
     configs = [{"name": n, "params": VARIANTS[n]} for n in names]
 
+    rows = []
+
     def emit(row):
+        rows.append(row)
         with open(args.out, "a") as f:
             f.write(json.dumps(row) + "\n")
         print(f"[variants] {row['config']}: {row['imgs_per_sec']} imgs/sec "
               f"(x{row['vs_baseline']})", file=sys.stderr, flush=True)
 
     bench.bench_configs("tpu", configs, emit)
+
+    # Ledger emission (repo-root artifact only): one record for the whole
+    # appended sweep, superseding the previous variants record.
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if rows and os.path.dirname(os.path.abspath(args.out)) == root:
+        from grace_tpu.evidence.ledger import record_artifact
+        n_dev = rows[0].get("n_devices")
+        record_artifact(
+            args.out, id="variants-tpu", metric="resnet50_variant_rows",
+            value=len(rows), claim_class="measured", tool="tpu_variants",
+            platform=rows[0].get("platform"), chip=rows[0].get("chip"),
+            n_devices=n_dev,
+            topology={"world": n_dev, "tiers": ["ici"], "slice": None,
+                      "region": None},
+            config=",".join(names), lint_clean=None)
 
 
 if __name__ == "__main__":
