@@ -216,15 +216,23 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
                                     "communicator": "rscatter",
                                     "fusion": "flat",
                                     "fsdp_axis": "fsdp"}, fsdp=2),
-    # ScaleCom-style cyclic local-selection Top-K: the negotiated shared
-    # index set makes the payload exactly summable, so it rides the psum
-    # allreduce at k values/rank — and the negotiation (a k-index masked
-    # broadcast, NOT inside the scalar atol) must be carried by the wire
-    # model explicitly, which this entry pins.
+    # ScaleCom-style cyclic Top-K: the rng+step-derived shared index set
+    # makes the payload exactly summable, so it rides the psum allreduce
+    # at k values/rank with ZERO negotiation bytes (the schedule is
+    # rank-deterministic — nothing to broadcast), which this entry pins.
     _cfg("cyclictopk-allreduce", {"compressor": "cyclictopk",
                                   "compress_ratio": 0.3,
                                   "memory": "residual",
                                   "communicator": "allreduce"}),
+    # The data-free-ctx unlock (ROADMAP item 4): cyclictopk's ctx is
+    # derived from the replicated rng alone, so the hop-pipelined ring
+    # rebuilds the scatter map per shard and the exact payload algebra
+    # sums losslessly hop by hop.
+    _cfg("cyclictopk-ring", {"compressor": "cyclictopk",
+                             "compress_ratio": 0.3,
+                             "memory": "residual",
+                             "communicator": "ring",
+                             "fusion": "flat"}),
     # First-class per-leaf codec routing (1-D): the wire model becomes the
     # SUM of per-leaf prices through each leaf's own codec/communicator —
     # wire_reconciliation audits the routed spelling end to end.
@@ -359,6 +367,33 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
           "memory": "residual", "communicator": "allgather",
           "escape": "fp16", "telemetry": True, "consensus": True,
           "adapt": {"window": 5, "ladder": [{"compress_ratio": 0.2}]}},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
+    # -- graft-retune variants (ISSUE 18): the two configs the online
+    #    re-tuner promotes between. The PowerSGD rank ladder is the
+    #    rung-invariant layout's standing proof: every rung's Q/P state is
+    #    padded to the ladder max rank so ONE lax.switch dispatches all
+    #    rungs over one state shape — a rank move is a mask flip, never a
+    #    reshape, which is what makes mid-run promotion (and the adapt
+    #    controller's tighten/loosen) a pure index change the auditor can
+    #    trace. This entry is also what the retune PREPARE gate audits
+    #    before staging a powersgd+ladder candidate.
+    _cfg("adapt-powersgd-rankladder",
+         {"compressor": "powersgd", "compress_rank": 4,
+          "memory": "powersgd", "communicator": "allreduce",
+          "escape": "fp16", "telemetry": True,
+          "adapt": {"window": 5, "ladder": [{"compress_rank": 1}]}},
+         passes=_NO_WIRE),
+    # The retune drill's incumbent under the full resilience stack: the
+    # shared-scale homomorphic codec inside the guarded train step with
+    # the consensus audit fingerprinting its replicated state — the exact
+    # config the controller checkpoints as last-known-good and demotes
+    # back to, so its audited trace is the standing proof the demotion
+    # target itself lints clean.
+    _cfg("retune-incumbent-homoqsgd",
+         {"compressor": "homoqsgd", "quantum_num": 7, "memory": "residual",
+          "communicator": "allreduce", "fusion": "flat", "escape": "fp16",
+          "telemetry": True, "consensus": True},
          passes=_NO_WIRE, mode="train",
          guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
     # -- resilience variants: the conds the auditor exists for --------------

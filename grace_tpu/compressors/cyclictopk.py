@@ -1,35 +1,51 @@
-"""Cyclic local-selection Top-K — ScaleCom's scalable sparsification.
+"""Cyclic local-selection Top-K — ScaleCom's scalable sparsification,
+re-derived with a rank-deterministic cyclic schedule.
 
 Per-rank independent Top-K degrades at scale twice over (ScaleCom,
 arXiv:2104.11125 — PAPERS.md): the union of W ranks' index sets grows
 toward W·k (the gather cost cliff), and the aggregate keeps shrinking
 toward the intersection of everyone's preferences. ScaleCom's CLT-k fix:
-each step ONE rank's *local* selection decides the index set for the
-whole fleet, and the deciding rank cycles — error feedback re-injects
-every other rank's unselected mass, so over a cycle all ranks'
-preferences are heard, while the per-step index set stays exactly k.
+ONE shared index set per step, so the per-step set stays exactly k and
+payloads sum exactly; error feedback re-injects every rank's unselected
+mass, so over a cycle all coordinates are heard.
 
-Mapped onto this repo's negotiation machinery (the PR-13 hoist):
+The original port (PR 13) realized the shared set as a *negotiation*: a
+rotating leader's local top-k indices masked-broadcast fleet-wide. That
+bought the exact algebra but chained the ctx to one rank's DATA — the
+index set could not be re-derived locally, so every decode path that
+reconstructs ctx per shard (compressed ring / reduce-scatter hops, the
+hier WAN gather) rejected the codec (``ctx_is_data_free`` gate), and the
+broadcast itself was a priced extra collective.
 
-1. **negotiate** — the leader for this (step, leaf) is derived from the
-   replicated rng key (rank-identical by the transform's rng contract;
-   a pseudo-random rotation with the same coverage as ScaleCom's
-   round-robin, needing no step counter in a stateless codec). The
-   leader's local top-k indices are :func:`~grace_tpu.comm.
-   masked_broadcast` to every rank — ONE small integer collective,
-   priced via :meth:`negotiation_nbytes`.
-2. **encode** — every rank ships its values AT THE SHARED INDICES.
-3. **aggregate** — because the index set is rank-identical, payloads sum
-   **exactly in payload space** (``payload_algebra='exact'``): Allreduce
-   psums k values instead of gathering W·k, and no schedule ever pays a
-   requant. This is the property per-rank Top-K structurally cannot
-   have (its per-rank index sets are why ``topk`` declares no algebra).
+This revision keeps the exact shared-set algebra and drops the data
+dependence (ROADMAP item 4): the index set is a **cyclic strided window
+derived from the replicated rng** — the transform folds the step counter
+into the key, so the schedule is "rng + step", rotating its phase every
+step with ScaleCom-round-robin coverage in distribution. Every rank
+(and every hop of a sharded schedule) derives the identical set from the
+key alone:
 
-Residual coverage: a non-leader's large coordinates that the leader
-missed land in error-feedback memory verbatim and re-compete next step —
-ScaleCom §III's convergence argument. The codec is stateless; without a
-bound mesh axis (Identity/single-process) it falls back to local
-selection, which decodes its own payload exactly.
+1. **select** — ``start = randint(fold_in(rng, salt), 0, numel)``,
+   ``stride = numel // k``; the set is ``(start + i·stride) mod numel``.
+   Strided rather than contiguous so one window spans the whole tensor —
+   adjacent coordinates (a conv kernel's neighborhood) land in different
+   windows and the k slots sample uniformly across the leaf each step.
+2. **encode** — every rank ships its values at the shared indices.
+3. **aggregate** — the set is rank-identical by construction, so payloads
+   sum **exactly in payload space** (``payload_algebra='exact'``), and —
+   new here — the ctx is data-free, so the hop-pipelined and hierarchical
+   schedules accept the codec and rebuild the index set locally per
+   shard.
+
+What changed vs ScaleCom's CLT-k: the per-step set is schedule-driven
+(cyclic coverage guaranteed by construction) instead of magnitude-driven
+through a leader (coverage in expectation, bias toward the leader's large
+coordinates). Error feedback makes both convergent — unselected mass
+re-competes every step — and the schedule costs ZERO negotiation bytes:
+there is nothing to broadcast.
+
+The codec is stateless and needs no mesh axis at selection time; under
+Identity/single-process it decodes its own payload exactly.
 """
 
 from __future__ import annotations
@@ -38,76 +54,51 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
-from grace_tpu.core import Compressor, Ctx, Payload, State, axis_size
+from grace_tpu.core import Compressor, Ctx, Payload, State
 from grace_tpu.compressors.topk import static_k
 from grace_tpu.ops.sparse import scatter_dense
 
 
 @dataclasses.dataclass(frozen=True)
 class CyclicTopKCompressor(Compressor):
-    # The negotiated shared index set is exactly what makes the payload
-    # linear: sum-of-payloads decodes to sum-of-decodes bit-for-bit (same
-    # scatter coordinates on every rank), so every payload-space schedule
-    # (Allreduce psum, ring/rscatter hop adds) is exact. Per-rank topk
-    # cannot claim this; the negotiation is the price of the algebra.
+    # The shared index set is exactly what makes the payload linear:
+    # sum-of-payloads decodes to sum-of-decodes bit-for-bit (same scatter
+    # coordinates on every rank), so every payload-space schedule
+    # (Allreduce psum, ring/rscatter hop adds, hier gathers) is exact.
+    # Per-rank topk cannot claim this.
     payload_algebra = "exact"
     # Re-selecting over a partial sum would change the index set mid-
-    # schedule and desync it from the negotiated ctx — the exact payload
-    # algebra already gives every hop-pipelined schedule a lossless path.
+    # schedule — the exact payload algebra already gives every
+    # hop-pipelined schedule a lossless path, so nothing requants.
     supports_hop_requant = False
-    # Non-scale negotiation (a leader's index set): communicators hoist
-    # negotiate() before the stage-1 encode via core.needs_negotiation.
-    negotiates = True
 
     compress_ratio: float = 0.01
 
-    def negotiate(self, x: jax.Array, axis_name: str, rng=None):
-        """Leader election + index broadcast: the rank picked from the
-        replicated ``rng`` computes local top-k indices; every rank
-        receives them bit-exactly (integer masked-broadcast psum)."""
-        from grace_tpu.comm import masked_broadcast
+    def _schedule(self, rng: jax.Array, numel: int) -> jax.Array:
+        """The cyclic window for this (step, leaf): k distinct indices
+        derived from the replicated key alone. The transform's rng
+        contract (``fold_in(base_key, count)`` then per-leaf fold) makes
+        this rank-identical AND step-rotating with no codec state."""
+        k = static_k(numel, self.compress_ratio)
+        start = jax.random.randint(jax.random.fold_in(rng, 0x5ca1e),
+                                   (), 0, numel, dtype=jnp.int32)
+        stride = jnp.int32(max(1, numel // k))
+        # (k-1)·stride < numel for stride = numel // k, so the k strided
+        # offsets are distinct modulo numel — a permutation-free proof
+        # the scatter never collides.
+        return (start + jnp.arange(k, dtype=jnp.int32) * stride) % numel
 
-        w = axis_size(axis_name)
-        flat = x.reshape(-1)
-        k = static_k(flat.size, self.compress_ratio)
-        if rng is None:
-            leader = jnp.zeros((), jnp.int32)
-        else:
-            # Replicated key -> replicated leader; rotates per (step,
-            # leaf) with ScaleCom-round-robin coverage in distribution.
-            leader = jax.random.randint(jax.random.fold_in(rng, 0x5ca1e),
-                                        (), 0, w, dtype=jnp.int32)
-        _, idx = lax.top_k(jnp.abs(flat), k)
-        return masked_broadcast(idx.astype(jnp.int32), leader, axis_name)
-
-    def negotiation_nbytes_for(self, n_elems: int, world: int) -> int:
-        """Per-rank received bytes of one index broadcast for an
-        ``n_elems``-element leaf — the leaf-aware spelling the telemetry
-        wire plan and the auditor's model use."""
-        k = static_k(int(n_elems), self.compress_ratio)
-        return 2 * 4 * k * max(0, world - 1) // max(1, world)
-
-    def compress(self, x: jax.Array, state: State, rng: jax.Array,
-                 shared: jax.Array | None = None
+    def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
-        """Ship values at the negotiated indices (``shared``); fall back
-        to rank-local selection when no negotiation ran (Identity /
-        single-process — decodes this rank's own payload exactly, it
-        just isn't the shared-index algebra)."""
         shape, numel = x.shape, x.size
         flat = x.reshape(-1)
-        k = static_k(numel, self.compress_ratio)
-        if shared is None:
-            _, idx = lax.top_k(jnp.abs(flat), k)
-            idx = idx.astype(jnp.int32)
-        else:
-            idx = shared.astype(jnp.int32)
+        idx = self._schedule(rng, numel)
         values = flat[idx]
-        # idx rides in ctx, not the payload: it is rank-identical (the
-        # whole point of the negotiation), so payload-space sums touch
-        # values only and decode against one shared scatter map.
+        # idx rides in ctx, not the payload: it is rank-identical and
+        # data-free (derived from the replicated rng), so payload-space
+        # sums touch values only and ANY rank/hop can rebuild the same
+        # scatter map from the key — the data-free-ctx decode contract.
         return (values,), (idx, numel, shape, x.dtype), state
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:

@@ -32,11 +32,27 @@ the native JAX layout; 2-D weights are unchanged.
 Orthogonalization uses ``jnp.linalg.qr`` — a fused XLA op on the MXU —
 instead of the reference's column-by-column @torch.jit.script Gram-Schmidt
 (powersgd.py:7-18), which would serialize r matvecs.
+
+Rung-invariant state layout (graft-retune): an adapt ladder across
+PowerSGD *ranks* must thread one comp-state structure through every
+``lax.switch`` branch, but a rank-r rung natively stores a ``(m, r)`` Q —
+structurally different per rung. ``state_rank`` decouples the stored
+layout from the active rank: the per-leaf state is padded to
+``(m, min(n, m, state_rank))`` and each rung operates on its leading
+``rank`` columns, writing its refined Q back into that slice and carrying
+the inactive tail columns UNCHANGED. That makes the padding a warm-start
+carrier, not dead weight — when the controller moves UP a rung, the new
+columns resume from whatever power-iteration state they last held (the
+PowerSGD paper's warm-start result, extended across rung moves). With
+``state_rank=None`` (or ``== rank``) the slice and re-pad are no-ops and
+the codec is bit-identical to the unpadded layout. Wire pricing is
+untouched: only the ACTIVE ``(n + m) * rank`` factors ever travel.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +67,11 @@ class PowerSGDCompressor(Compressor):
     rank: int = 1
     warm_start: bool = True
     axis_name: str = DEFAULT_AXIS
+    # Stored-Q column count for rung-invariant adapt ladders: pad the
+    # per-leaf state to the ladder's max rank so every rung threads the
+    # same structure through lax.switch. None = store exactly `rank`
+    # columns (the classic layout). Must be >= rank when set.
+    state_rank: Optional[int] = None
     # 1-D leaves ride the communicator dense; >=2-D leaves were already
     # psum-reduced inside compress, so the outer allreduce sees a replicated
     # payload that sums/averages consistently — exact composition.
@@ -65,12 +86,29 @@ class PowerSGDCompressor(Compressor):
         r = min(n, m, self.rank)
         return n, m, r
 
+    def _state_cols(self, n: int, m: int) -> int:
+        """Stored-Q column count: the padded layout when ``state_rank``
+        is set, else exactly the active rank."""
+        if self.state_rank is not None:
+            if self.state_rank < self.rank:
+                raise ValueError(
+                    f"PowerSGD state_rank={self.state_rank} < rank="
+                    f"{self.rank}: the stored Q must hold at least the "
+                    "active columns")
+            return min(n, m, self.state_rank)
+        return min(n, m, self.rank)
+
     def init_state(self, x: jax.Array) -> State:
         if x.ndim <= 1:
             return None
-        _, m, r = self._factor_shapes(x.shape)
+        n, m, _ = self._factor_shapes(x.shape)
+        rs = self._state_cols(n, m)
         # Deterministic initial Q; identical on all ranks by construction.
-        return jax.random.normal(jax.random.key(x.size), (m, r), x.dtype)
+        # The bit-exactness claim for the padded layout holds at rs == r
+        # (state_rank None or == rank) — a wider draw is a different
+        # random matrix, which is fine: padding exists to serve ladders,
+        # whose quiet-run contract is judged per layout, not across them.
+        return jax.random.normal(jax.random.key(x.size), (m, rs), x.dtype)
 
     def wire_nbytes(self, shape, dtype) -> int:
         """Analytic: compress's psums of P (n,r) and Q (m,r) ARE the wire
@@ -90,8 +128,9 @@ class PowerSGDCompressor(Compressor):
         shape = x.shape
         n, m, r = self._factor_shapes(shape)
         matrix = x.reshape(n, m)   # n = prod(leading dims), m = shape[-1]
+        q_full = state             # (m, rs) with rs >= r; rs == r unpadded
         if self.warm_start:
-            q = state
+            q = q_full[:, :r]      # active columns only drive this rung
         else:
             # rng is replicated across ranks, so the redrawn Q agrees too.
             q = jax.random.normal(rng, (m, r), x.dtype)
@@ -102,7 +141,11 @@ class PowerSGDCompressor(Compressor):
         p, _ = jnp.linalg.qr(p)
         q = matrix.T @ p
         q = lax.psum(q, self.axis_name) / w
-        return (), (p, q, shape), q
+        # Re-pad: refined active columns in front, inactive tail carried
+        # untouched — the warm-start store for any HIGHER rung this ladder
+        # may move to. At rs == r the tail is empty and this is q itself.
+        return (), (p, q, shape), jnp.concatenate(
+            [q, q_full[:, r:]], axis=1)
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
         if ctx is None:

@@ -4,9 +4,10 @@ A telemetry :class:`~grace_tpu.telemetry.sinks.Sink` meant to ride a
 ``MultiSink`` next to the JSONL evidence sink: it observes the same
 record stream the monitors emit, keeps a bounded ring of recent records,
 and when a trigger fires — a guard trip (``guard_skip`` /
-``guard_fallback_engaged``), an adapt escalation (``adapt_tighten``), or
-a drain (``elastic_drain*``) — it snapshots everything a postmortem
-needs into ONE file:
+``guard_fallback_engaged``), an adapt escalation (``adapt_tighten``), a
+drain (``elastic_drain*``), or a retune transaction boundary
+(``retune_promote`` / ``retune_demote``) — it snapshots everything a
+postmortem needs into ONE file:
 
 * the telemetry ring (the last N records of every kind, verbatim),
 * the watch-timeline view of that ring (kind classification + counts,
@@ -39,10 +40,13 @@ __all__ = ["IncidentRecorder", "DEFAULT_TRIGGERS"]
 
 # Event-name prefixes that open an incident. `adapt_tighten` is the
 # controller acting *before* the guard — the flight recorder's whole
-# point is capturing the window where that race is decided.
+# point is capturing the window where that race is decided. A retune
+# promotion/demotion is a config transaction boundary: the window around
+# it is exactly what a "did the cutover cause this?" postmortem needs.
 DEFAULT_TRIGGERS: Tuple[str, ...] = (
     "guard_skip", "guard_fallback_engaged", "adapt_tighten",
-    "elastic_drain", "consensus_escalation")
+    "elastic_drain", "consensus_escalation", "retune_promote",
+    "retune_demote")
 
 
 def _utc_now() -> str:
@@ -83,6 +87,7 @@ class IncidentRecorder:
         self._adapt: List[Dict[str, Any]] = []
         self._guard: List[Dict[str, Any]] = []
         self._elastic: List[Dict[str, Any]] = []
+        self._retune: List[Dict[str, Any]] = []
         self._prof: Optional[Dict[str, Any]] = None
         self._last_trigger_step: Optional[int] = None
         self.incidents: List[str] = []        # written file paths
@@ -101,6 +106,8 @@ class IncidentRecorder:
                 self._guard.append(rec)
             elif event.startswith("elastic"):
                 self._elastic.append(rec)
+            elif event.startswith("retune"):
+                self._retune.append(rec)
             if self._should_trigger(rec, event):
                 self._snapshot(rec, event)
         except Exception as e:               # noqa: BLE001
@@ -166,6 +173,7 @@ class IncidentRecorder:
             "adapt_rungs": list(self._adapt),
             "guard_events": list(self._guard),
             "elastic_events": list(self._elastic),
+            "retune_events": list(self._retune),
             "prof": self._prof,
             "provenance": self.provenance,
             "captured_at": _utc_now(),

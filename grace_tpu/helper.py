@@ -90,6 +90,29 @@ class Grace:
                                adapt=self.adapt)
 
 
+def _pad_powersgd_states(base: Compressor, rungs: Tuple[Compressor, ...]
+                         ) -> Tuple[Compressor, Tuple[Compressor, ...]]:
+    """Rung-invariant PowerSGD layout for an adapt ladder: every PowerSGD
+    codec among the rungs AND the base (the base is the ladder's top rung,
+    and the transform allocates comp state from it) gets ``state_rank``
+    pinned to the ladder's max rank, so all rungs thread one padded
+    ``(m, max_rank)`` Q structure through the adapt ``lax.switch``.
+    No-op for ladders without PowerSGD, and for single-entry "ladders"
+    (base only, no rungs) where padding buys nothing."""
+    ps = [c for c in (*rungs, base)
+          if isinstance(c, C.PowerSGDCompressor)]
+    if not ps or not rungs:
+        return base, tuple(rungs)
+    pad = max(c.state_rank or c.rank for c in ps)
+
+    def fix(c):
+        if isinstance(c, C.PowerSGDCompressor) and c.state_rank != pad:
+            return dataclasses.replace(c, state_rank=pad)
+        return c
+
+    return fix(base), tuple(fix(c) for c in rungs)
+
+
 def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
     name = params.get("compressor", "none")
     ratio = params.get("compress_ratio", 0.3)
@@ -98,10 +121,11 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
     if name in ("fp16", "bf16", "bfloat16"):
         return C.FP16Compressor(dtype="float16" if name == "fp16" else "bfloat16")
     if name == "cyclictopk":
-        # ScaleCom-style cyclic local-selection Top-K: a rotating leader's
-        # local index set is negotiated fleet-wide, so the payload is
-        # exactly summable (payload_algebra='exact') — the large-W fix for
-        # per-rank topk's degradation cliff.
+        # ScaleCom-style cyclic Top-K: one shared k-index set per step,
+        # derived from the replicated rng + step (rank-deterministic,
+        # data-free ctx), so the payload is exactly summable
+        # (payload_algebra='exact') — the large-W fix for per-rank topk's
+        # degradation cliff, with zero negotiation bytes.
         return C.CyclicTopKCompressor(compress_ratio=ratio)
     if name == "topk":
         return C.TopKCompressor(
@@ -309,14 +333,18 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
             sub_entries.append((str(pattern), grace_from_params(merged)))
         routes = normalize_routes(
             sub_entries, _build_communicator(params, axis))
+    compressor = _build_compressor(params, axis)
     adapt_cfg = None
     if params.get("adapt"):
         from grace_tpu.resilience.adapt import AdaptConfig, normalize_adapt
 
         spec = params["adapt"]
-        base_comp = _build_compressor(params, axis)
         if isinstance(spec, AdaptConfig):
-            adapt_cfg = normalize_adapt(spec, base_comp)
+            compressor, ladder = _pad_powersgd_states(
+                compressor, tuple(spec.ladder))
+            if ladder != tuple(spec.ladder):
+                spec = dataclasses.replace(spec, ladder=ladder)
+            adapt_cfg = normalize_adapt(spec, compressor)
         else:
             if spec is True:
                 kwargs: Dict[str, Any] = {}
@@ -337,9 +365,16 @@ def grace_from_params(params: Dict[str, Any]) -> Grace:
                           if k not in ("adapt", "route")}
                 merged.update(dict(overrides))
                 rungs.append(_build_compressor(merged, axis))
+            # Rung-invariant PowerSGD layout: every PowerSGD codec in
+            # this ladder (base included — it IS the top rung, and the
+            # transform's comp state is allocated from the Grace
+            # compressor) stores Q at the ladder's max rank so the adapt
+            # lax.switch threads ONE state structure across rungs.
+            compressor, rungs = _pad_powersgd_states(
+                compressor, tuple(rungs))
             adapt_cfg = normalize_adapt(
-                AdaptConfig(ladder=tuple(rungs), **kwargs), base_comp)
-    return Grace(compressor=_build_compressor(params, axis),
+                AdaptConfig(ladder=rungs, **kwargs), compressor)
+    return Grace(compressor=compressor,
                  memory=_build_memory(params, axis),
                  communicator=_build_communicator(params, axis),
                  fusion=fusion,

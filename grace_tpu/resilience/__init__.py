@@ -31,6 +31,12 @@ it (SURVEY.md has no counterpart — the reference assumes a fault-free run):
   static codec and the dense escape, tightening within one window of an
   error spike (before the guard would trip) and loosening with
   hysteresis when gradients go quiet.
+* :mod:`~grace_tpu.resilience.retune` — graft-retune fault-tolerant
+  online re-tuning: config promotion as a two-phase transaction
+  (lint-audited, state-migrated, footprint-validated PREPARE;
+  consensus-gated COMMIT) with a probation window that demotes
+  bit-exactly on any guard trip or consensus escalation, every leg
+  under the elastic drain watchdog's bounded-timeout discipline.
 """
 
 from __future__ import annotations
@@ -53,6 +59,8 @@ from grace_tpu.resilience.elastic import (ElasticController, ResizePlan,
                                           reshard_grace_state,
                                           validate_resharded)
 from grace_tpu.resilience.guard import GuardState, guard_transform
+from grace_tpu.resilience.retune import (RetuneController, StagedPromotion,
+                                         state_digest)
 
 __all__ = ["GuardState", "guard_transform", "guarded_chain",
            "ChaosCompressor", "ChaosCommunicator", "ChaosParams",
@@ -62,7 +70,8 @@ __all__ = ["GuardState", "guard_transform", "guarded_chain",
            "reshard_grace_state", "validate_resharded", "rejoin_barrier",
            "implant_stale_replica", "replica_variants",
            "AdaptConfig", "AdaptState", "AdaptMonitor", "adapt_report",
-           "normalize_adapt"]
+           "normalize_adapt",
+           "RetuneController", "StagedPromotion", "state_digest"]
 
 
 def guarded_chain(grace, *txs: optax.GradientTransformation,

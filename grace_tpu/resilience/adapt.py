@@ -118,8 +118,12 @@ class AdaptConfig:
     (:func:`normalize_adapt` appends it when missing), and rung 0 — the
     dense escape — is implicit. Every rung must thread the same mem/comp
     state structure as the base codec (the ``lax.switch`` branches return
-    one state type; a PowerSGD rank ladder, whose Q factor changes shape
-    per rung, is rejected with a clear error at trace time).
+    one state type). PowerSGD rank ladders satisfy this through the
+    rung-invariant padded layout: every rung carries
+    ``state_rank = max(ranks)`` so all rungs store one ``(m, max_rank)``
+    Q and operate on their leading ``rank`` columns
+    (``grace_from_params`` pins this automatically; hand-built ladders
+    that skip it are rejected with a clear error at trace time).
 
     ``window`` — steps between decisions (the ``lax.cond`` gate on the
     replicated step counter, the consensus/watch idiom).

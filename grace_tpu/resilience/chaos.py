@@ -104,7 +104,11 @@ class ChaosCompressor(Compressor):
     residual norm away from the fleet — exactly the single-rank skew
     signal graft-watch (:mod:`grace_tpu.telemetry.aggregate`) exists to
     flag first. Only meaningful for codecs whose payload carries value
-    lanes (topk/threshold/qsgd-style); sign-only payloads pass through
+    lanes — float lanes (topk/threshold/qsgd-style) are attenuated
+    directly, and a shared-scale codec's integer level lanes are
+    attenuated on their quantization lattice (an integer lane is only a
+    value lane when the algebra says so; anywhere else integers are
+    indices and pass through untouched). Sign-only payloads pass through
     scaling unchanged in effect.
     """
 
@@ -208,10 +212,25 @@ class ChaosCompressor(Compressor):
             payload = tuple(corrupted)
         if self.drift_scale:
             scale = jnp.where(gate, 1.0 - self.drift_scale, 1.0)
-            payload = tuple(
-                (t * jnp.asarray(scale, t.dtype)
-                 if jnp.issubdtype(t.dtype, jnp.inexact) else t)
-                for t in payload)
+            shared_scale = (getattr(self.inner, "payload_algebra", None)
+                            == "shared_scale")
+
+            def _attenuate(t):
+                if jnp.issubdtype(t.dtype, jnp.inexact):
+                    return t * jnp.asarray(scale, t.dtype)
+                if shared_scale and jnp.issubdtype(t.dtype, jnp.integer):
+                    # A shared-scale codec's integer lanes ARE its value
+                    # lanes (levels against the negotiated scale — never
+                    # indices), so the degrading encoder attenuates them
+                    # too: scaled on the quantization lattice, which
+                    # stays finite, sums homomorphically, and moves the
+                    # gated rank's compression error exactly like the
+                    # float-lane attenuation does for topk/qsgd.
+                    return jnp.round(
+                        t.astype(jnp.float32) * scale).astype(t.dtype)
+                return t
+
+            payload = tuple(_attenuate(t) for t in payload)
         return payload, ctx, new_state
 
 

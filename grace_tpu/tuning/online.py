@@ -1,0 +1,101 @@
+"""Online re-tuning funnel: the offline tuner's decision loop, bounded
+and re-runnable against a LIVE fleet mid-run.
+
+``run_tune`` is an offline ceremony — it assumes it owns the process, may
+trace/profile at leisure, and stamps its winner into ``TUNE_LAST.json``
+for a human to adopt. The re-tuner cannot afford any of that: it runs
+while a training job is paused at a drain boundary, its time budget is
+the probation the fleet grants it, and a hung candidate measurement must
+cost a bounded number of seconds, not the run. :func:`online_funnel` is
+therefore run_tune's funnel with the offline parts cut away and the
+bounded parts forced on:
+
+* same **static funnel** (:func:`~grace_tpu.tuning.prune.static_prune`):
+  capability gates, numeric safety at the live world, per-link wire
+  pricing, flow passes — every rejection recorded with its reason, so a
+  promotion's PREPARE audit can show why the winner beat the field;
+* same **measured shortlist**
+  (:func:`~grace_tpu.tuning.measure.measure_shortlist`) on the live mesh,
+  but with ``measure_timeout_s`` REQUIRED in spirit: the default here is
+  a finite timeout, and a hung candidate lands in ``skipped`` with
+  ``verdict='measure_timeout'`` after bounded retries with doubling
+  backoff instead of stalling the controller;
+* **no overlap sandwich, no evidence stamp** — the honesty gate for an
+  online promotion is the transaction itself
+  (:class:`~grace_tpu.resilience.retune.RetuneController`: lint audit,
+  footprint check, consensus-gated cutover, probation with automatic
+  demotion), which supersedes the offline sandwich's role;
+* an ``include`` hook so the controller can force the incumbent and any
+  operator-prescribed candidates (a PowerSGD rank ladder, a dense escape)
+  into the field even when enumeration would not generate them.
+
+The returned document is the PREPARE record's ``funnel`` payload: static
+funnel, measured rows, skip verdicts, winner name + loadable
+``winner_params``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Sequence, Union
+
+from grace_tpu.tuning.candidates import Candidate, enumerate_candidates
+from grace_tpu.tuning.cost import TuneTopology
+from grace_tpu.tuning.measure import measure_shortlist, model_structs
+from grace_tpu.tuning.prune import static_prune
+
+__all__ = ["ONLINE_MEASURE_TIMEOUT_S", "online_funnel"]
+
+# The online default is FINITE: a re-tune decision taken mid-run must
+# never inherit the offline tuner's wait-forever behavior. Callers can
+# widen it (or pass None to opt back into unbounded, e.g. under a
+# debugger) but they have to do it on purpose.
+ONLINE_MEASURE_TIMEOUT_S = 120.0
+
+
+def online_funnel(topology: Union[str, TuneTopology], mesh, *,
+                  model: str = "toy", shortlist_n: int = 3,
+                  audit_world: int = 8, timed_steps: int = 4,
+                  repeats: int = 1, seed: int = 0,
+                  measure_timeout_s: Optional[float]
+                  = ONLINE_MEASURE_TIMEOUT_S,
+                  measure_retries: int = 1,
+                  include: Optional[Sequence[Candidate]] = None,
+                  exclude: Iterable[str] = ()) -> Dict[str, Any]:
+    """One bounded re-tune decision against the live mesh.
+
+    Enumerates candidates for ``topology`` (plus any ``include``d ones,
+    minus ``exclude``d names), runs the static funnel, measures the
+    shortlist with bounded per-candidate timeouts, and returns::
+
+        {"topology", "static", "measured", "winner", "winner_params"}
+
+    ``winner`` is None when nothing survived to a measurement — the
+    controller treats that as "stay on the incumbent", never as an error.
+    """
+    spec = (topology if isinstance(topology, TuneTopology)
+            else TuneTopology.parse(topology))
+    structs = model_structs(model)
+    cands = list(enumerate_candidates(spec))
+    if include:
+        names = {c.name for c in cands}
+        cands += [c for c in include if c.name not in names]
+    drop = set(exclude)
+    if drop:
+        cands = [c for c in cands if c.name not in drop]
+    funnel = static_prune(cands, spec, structs, audit_world=audit_world,
+                          shortlist_n=shortlist_n)
+    by_name = {c.name: c for c in cands}
+    shortlist = [by_name[n] for n in funnel["shortlist"]]
+    measured = measure_shortlist(
+        shortlist, spec, mesh, model=model, timed_steps=timed_steps,
+        repeats=repeats, seed=seed, measure_timeout_s=measure_timeout_s,
+        measure_retries=measure_retries)
+    winner = measured["winner"]
+    return {
+        "topology": spec.label,
+        "static": funnel,
+        "measured": measured,
+        "winner": winner,
+        "winner_params": (dict(by_name[winner].params)
+                         if winner is not None else None),
+    }

@@ -1,0 +1,478 @@
+"""graft-retune: fault-tolerant online re-tuning (ISSUE 18).
+
+Pins the transaction contract of
+:class:`grace_tpu.resilience.retune.RetuneController` — drift watch,
+two-phase PREPARE/COMMIT promotion, probation + automatic bit-exact
+demotion, and the bounded-timeout discipline on every transition leg —
+plus the rung-invariant GraceState migration map
+(:func:`grace_tpu.transform.migrate_grace_state`: carried / overlap /
+fresh verdicts, PowerSGD warm-started Q across rank changes) and the
+tuner's measure-timeout verdicts (:func:`grace_tpu.tuning.measure.
+bounded_call` / ``measure_shortlist`` with a stalling candidate).
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from grace_tpu import grace_from_params
+from grace_tpu.resilience import (ConsensusConfig, RetuneController,
+                                  guarded_chain, state_digest)
+from grace_tpu.train import init_train_state, make_train_step
+from grace_tpu.transform import GraceState, migrate_grace_state
+from grace_tpu.tuning.measure import MeasureTimeout, bounded_call
+
+pytestmark = pytest.mark.retune
+
+
+# ---------------------------------------------------------------------------
+# bounded_call / measure-timeout verdicts (the tuner's watchdog)
+# ---------------------------------------------------------------------------
+
+def test_bounded_call_returns_value():
+    assert bounded_call(lambda: 41 + 1, 5.0) == 42
+    assert bounded_call(lambda: "inline", None) == "inline"
+
+
+def test_bounded_call_timeout_attempts_and_backoff():
+    calls = []
+
+    def stall():
+        calls.append(1)
+        time.sleep(30)
+
+    t0 = time.perf_counter()
+    with pytest.raises(MeasureTimeout) as ei:
+        bounded_call(stall, 0.05, retries=2, label="wedged")
+    dt = time.perf_counter() - t0
+    # Three attempts with doubling backoff: 0.05 + 0.1 + 0.2.
+    assert ei.value.attempts == 3
+    assert ei.value.timeout_s == pytest.approx(0.2)
+    assert len(calls) == 3
+    assert 0.3 < dt < 5.0
+    assert "wedged" in str(ei.value)
+
+
+def test_bounded_call_exception_propagates_unretried():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("deterministic failure")
+
+    with pytest.raises(ValueError, match="deterministic failure"):
+        bounded_call(boom, 5.0, retries=3)
+    # A deterministic failure must not become flaky success by repetition.
+    assert len(calls) == 1
+
+
+def test_measure_shortlist_timeout_verdict(mesh):
+    """A wedged candidate lands in ``skipped`` with
+    ``verdict='measure_timeout'`` (attempts + final timeout recorded), a
+    crashing one with ``verdict='error'`` — and the funnel moves on past
+    both instead of hanging or raising."""
+    from grace_tpu.tuning.cost import TuneTopology
+    from grace_tpu.tuning.measure import measure_shortlist
+
+    class _Stall:
+        name = "wedged-candidate"
+        tpu_only = False
+
+        def build(self):
+            time.sleep(30)
+
+    class _Crash:
+        name = "crashing-candidate"
+        tpu_only = False
+
+        def build(self):
+            raise RuntimeError("compile exploded")
+
+    doc = measure_shortlist([_Stall(), _Crash()], TuneTopology.parse("8"),
+                            mesh, timed_steps=2, repeats=1,
+                            measure_timeout_s=0.2, measure_retries=1)
+    rows = {s["candidate"]: s for s in doc["skipped"]}
+    assert rows["wedged-candidate"]["verdict"] == "measure_timeout"
+    assert rows["wedged-candidate"]["attempts"] == 2
+    assert rows["wedged-candidate"]["timeout_s"] == pytest.approx(0.4)
+    assert rows["crashing-candidate"]["verdict"] == "error"
+    assert "compile exploded" in rows["crashing-candidate"]["reason"]
+    assert doc["rows"] == [] and doc["winner"] is None
+    assert doc["measure_timeout_s"] == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# migration map: carried / overlap / fresh, PowerSGD warm start
+# ---------------------------------------------------------------------------
+
+def _mlp_params(rng):
+    return {
+        "w1": jnp.asarray(rng.normal(scale=0.3, size=(32, 16)),
+                          jnp.float32),
+        "b1": jnp.zeros((16,), jnp.float32),
+        "w2": jnp.asarray(rng.normal(scale=0.3, size=(16, 8)), jnp.float32),
+        "b2": jnp.zeros((8,), jnp.float32),
+    }
+
+
+def _loss_fn(p, b):
+    x, y = b
+    h = jnp.tanh(x @ p["w1"] + p["b1"])
+    logits = h @ p["w2"] + p["b2"]
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _batch(rng, n=16):
+    return (jnp.asarray(rng.normal(size=(n, 32)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 8, size=(n,)).astype(np.int32)))
+
+
+def _powersgd_state(mesh, rng, rank):
+    grc = grace_from_params({"compressor": "powersgd",
+                             "compress_rank": rank,
+                             "memory": "powersgd",
+                             "communicator": "allreduce"})
+    tx = optax.chain(grc.transform(seed=0), optax.sgd(0.05))
+    state = init_train_state(_mlp_params(rng), tx, mesh)
+    step = make_train_step(_loss_fn, tx, mesh, donate=False)
+    state, _ = step(state, _batch(rng))
+    return state
+
+
+def _grace_nodes(tree):
+    out = []
+    jax.tree_util.tree_map(
+        lambda n: out.append(n) if isinstance(n, GraceState) else n,
+        tree, is_leaf=lambda n: isinstance(n, GraceState))
+    return out
+
+
+def test_migrate_powersgd_rank_change_warm_starts_q(mesh, rng):
+    """rank 2 → rank 4 within the PowerSGD family: every per-direction
+    leaf migrates by LAST-AXIS overlap — the first two columns carry
+    bit-exactly, the new columns keep the fresh draw."""
+    old = _powersgd_state(mesh, rng, rank=2)
+    fresh = _powersgd_state(mesh, np.random.default_rng(1), rank=4)
+    migrated_opt, stats = migrate_grace_state(old.opt_state,
+                                              fresh.opt_state)
+    assert stats["comp_structure_match"] and stats["mem_structure_match"]
+    # The matrix leaves (w1, w2) carry rank-shaped Q/P state: overlap.
+    assert stats["comp"]["overlap"] + stats["mem"]["overlap"] >= 2
+    assert stats["comp"]["fresh"] == 0 and stats["mem"]["fresh"] == 0
+
+    old_g, new_g = _grace_nodes(old.opt_state)[0], \
+        _grace_nodes(migrated_opt)[0]
+    fresh_g = _grace_nodes(fresh.opt_state)[0]
+    checked = 0
+    for o, n, f in zip(jax.tree_util.tree_leaves(old_g.comp),
+                       jax.tree_util.tree_leaves(new_g.comp),
+                       jax.tree_util.tree_leaves(fresh_g.comp)):
+        if (hasattr(o, "ndim") and o.ndim >= 2
+                and o.shape[:-1] == n.shape[:-1]
+                and o.shape[-1] == 2 and n.shape[-1] == 4):
+            np.testing.assert_array_equal(np.asarray(n[..., :2]),
+                                          np.asarray(o))
+            np.testing.assert_array_equal(np.asarray(n[..., 2:]),
+                                          np.asarray(f[..., 2:]))
+            checked += 1
+    assert checked >= 1
+    # Replicated fields carry bit-exactly: the step counter continues.
+    assert int(np.asarray(jax.device_get(new_g.count)).reshape(-1)[0]) == \
+        int(np.asarray(jax.device_get(old_g.count)).reshape(-1)[0])
+
+
+def test_migrate_cross_family_is_fresh(mesh, rng):
+    """homoqsgd → powersgd: no meaningful warm state exists — comp/mem
+    take the fresh init (structure mismatch), replicated fields carry."""
+    grc = grace_from_params({"compressor": "homoqsgd", "quantum_num": 7,
+                             "memory": "residual",
+                             "communicator": "allreduce",
+                             "fusion": "flat"})
+    tx = optax.chain(grc.transform(seed=0), optax.sgd(0.05))
+    old = init_train_state(_mlp_params(rng), tx, mesh)
+    fresh = _powersgd_state(mesh, np.random.default_rng(1), rank=4)
+    migrated_opt, stats = migrate_grace_state(old.opt_state,
+                                              fresh.opt_state)
+    assert not stats["comp_structure_match"]
+    assert stats["comp"]["carried"] == stats["comp"]["overlap"] == 0
+    new_g = _grace_nodes(migrated_opt)[0]
+    old_g = _grace_nodes(old.opt_state)[0]
+    np.testing.assert_array_equal(np.asarray(jax.device_get(new_g.count)),
+                                  np.asarray(jax.device_get(old_g.count)))
+
+
+def test_state_digest_is_content_sensitive(mesh, rng):
+    state = _powersgd_state(mesh, rng, rank=2)
+    d1 = state_digest(state)
+    assert d1 == state_digest(state)
+    bumped = state._replace(params={**state.params,
+                                    "b1": state.params["b1"] + 1.0})
+    assert state_digest(bumped) != d1
+
+
+# ---------------------------------------------------------------------------
+# controller: drift watch, watchdog legs, probation semantics (host-side)
+# ---------------------------------------------------------------------------
+
+def _host_controller(**kw):
+    kw.setdefault("build", lambda p: (None, None))
+    kw.setdefault("params", {"compressor": "homoqsgd"})
+    return RetuneController(**kw)
+
+
+def test_observe_fires_only_on_sustained_drift():
+    ctl = _host_controller(window=4, drift_factor=2.0, drift_windows=2)
+    fired = []
+    step = 0
+    # Window 1 learns the baseline (mean 1.0); window 2 is healthy.
+    for v in [1.0] * 8:
+        fired.append(ctl.observe(step, v))
+        step += 1
+    # One hot window is not sustained drift yet...
+    for v in [3.0] * 4:
+        fired.append(ctl.observe(step, v))
+        step += 1
+    assert not any(fired)
+    # ...the second consecutive hot window is.
+    out = [ctl.observe(step + i, 3.0) for i in range(4)]
+    assert out[-1] is True
+    assert ctl.events[-1]["event"] == "retune_drift"
+    assert ctl.events[-1]["baseline"] == pytest.approx(1.0)
+    # None rows (telemetry disabled) are ignored, not counted as zeros.
+    assert ctl.observe(99, None) is False
+
+
+def test_observe_hot_streak_resets_on_quiet_window():
+    ctl = _host_controller(window=2, drift_factor=1.5, drift_windows=2)
+    for i, v in enumerate([1.0, 1.0]):          # baseline
+        ctl.observe(i, v)
+    assert ctl.observe(2, 5.0) is False
+    assert ctl.observe(3, 5.0) is False         # hot window 1
+    assert ctl.observe(4, 1.0) is False
+    assert ctl.observe(5, 1.0) is False         # quiet: streak resets
+    assert ctl.observe(6, 5.0) is False
+    assert ctl.observe(7, 5.0) is False         # hot window 1 again
+    assert not any(e["event"] == "retune_drift" for e in ctl.events)
+
+
+def test_watchdog_bounds_a_hung_leg_and_records_timeouts():
+    ctl = _host_controller(leg_timeout_s=0.05, leg_retries=1)
+    ok, result, timeouts = ctl._watchdog("drill", 7,
+                                         lambda: time.sleep(30))
+    assert ok is False and result is None and timeouts == 2
+    recs = [e for e in ctl.events if e["event"] == "retune_timeout"]
+    assert len(recs) == 2
+    assert recs[0]["leg"] == "drill" and recs[0]["attempt"] == 1
+    assert recs[1]["timeout_s"] == pytest.approx(0.1)   # doubled
+    # A healthy leg passes through with no events.
+    ok, result, timeouts = ctl._watchdog("drill", 8, lambda: "done")
+    assert ok and result == "done" and timeouts == 0
+
+
+def test_watchdog_exceptions_propagate_unretried():
+    ctl = _host_controller(leg_timeout_s=5.0, leg_retries=3)
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("leg failed")
+
+    with pytest.raises(RuntimeError, match="leg failed"):
+        ctl._watchdog("drill", 0, boom)
+    assert len(calls) == 1
+
+
+def test_watch_triggers_on_guard_and_clears_quiet():
+    ctl = _host_controller(probation_steps=10,
+                           demote_on=("guard_skip", "consensus_escalation"))
+    ctl.phase = "probation"
+    ctl._probation_until = 10
+    # Telemetry metric rows (no event) and benign events pass through.
+    assert ctl.watch(3, [{"step": 3, "grad_norm": 1.0},
+                         {"event": "watch", "step": 3}]) is None
+    assert ctl.watch(5, [{"event": "guard_skip", "step": 5}]) == \
+        "guard_skip"
+    assert ctl.phase == "probation"      # watch reports; demote() acts
+    # Past the horizon with no trigger: the promotion sticks.
+    assert ctl.watch(10, []) is None
+    assert ctl.phase == "idle"
+    assert ctl.events[-1]["event"] == "retune_probation_clear"
+
+
+def test_controller_validates_knobs():
+    with pytest.raises(ValueError, match="drift_factor"):
+        _host_controller(drift_factor=1.0)
+    with pytest.raises(ValueError, match="window"):
+        _host_controller(window=0)
+    with pytest.raises(ValueError, match="leg_timeout_s"):
+        _host_controller(leg_timeout_s=0.0)
+    with pytest.raises(ValueError, match="leg_retries"):
+        _host_controller(leg_retries=-1)
+
+
+# ---------------------------------------------------------------------------
+# the full transaction on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+OLD_PARAMS = {"compressor": "homoqsgd", "quantum_num": 7,
+              "memory": "residual", "communicator": "allreduce",
+              "fusion": "flat", "escape": "fp16", "telemetry": 16,
+              "consensus": ConsensusConfig(audit_every=10)}
+NEW_PARAMS = {"compressor": "powersgd", "compress_rank": 4,
+              "memory": "powersgd", "communicator": "allreduce",
+              "escape": "fp16", "telemetry": 16,
+              "consensus": ConsensusConfig(audit_every=10),
+              "adapt": {"window": 5, "ladder": [{"compress_rank": 1}]}}
+
+
+def _build(p):
+    grc = grace_from_params(p)
+    tx = guarded_chain(grc, optax.sgd(0.05), fallback_after=3,
+                       fallback_steps=4)
+    return grc, tx
+
+
+def _controller(ckpt_dir, **kw):
+    from grace_tpu.checkpoint import Checkpointer
+    kw.setdefault("window", 4)
+    kw.setdefault("probation_steps", 8)
+    kw.setdefault("leg_timeout_s", 120.0)
+    return RetuneController(
+        build=_build, params=OLD_PARAMS,
+        consensus=OLD_PARAMS["consensus"],
+        checkpointer=Checkpointer(str(ckpt_dir), max_to_keep=2), **kw)
+
+
+def _warm(mesh, rng, steps=4):
+    grc, tx = _build(OLD_PARAMS)
+    state = init_train_state(_mlp_params(rng), tx, mesh)
+    step = make_train_step(_loss_fn, tx, mesh, donate=False)
+    for i in range(steps):
+        state, loss = step(state, _batch(rng))
+    return state, float(loss)
+
+
+def test_promotion_transaction_and_probation_clear(mesh, rng, tmp_path):
+    """PREPARE stages without touching live state, COMMIT cuts over
+    behind the consensus barrier, a quiet probation window clears."""
+    from grace_tpu.resilience import replica_variants
+
+    state, _ = _warm(mesh, rng)
+    ctl = _controller(tmp_path / "ckpt")
+    pre_digest = state_digest(state)
+
+    staged = ctl.prepare(4, state, mesh, NEW_PARAMS)
+    assert staged is not None and ctl.phase == "prepared"
+    assert staged.footprint_matches and staged.checkpointed
+    # PREPARE never wrote the incumbent: live state is bit-identical.
+    assert state_digest(state) == pre_digest
+
+    out = ctl.commit(4, mesh)
+    assert out is not None
+    state, (grc2, tx2), ev = out
+    assert ev["event"] == "retune_promote"
+    assert ev["old"] == "homoqsgd" and ev["new"] == "powersgd"
+    assert ev.get("replica_variants", 1) == 1
+    assert ctl.phase == "probation"
+    assert replica_variants(state.params) == 1
+
+    # The promoted transform trains (the PowerSGD ladder dispatches
+    # through one lax.switch) and a quiet probation clears.
+    step2 = make_train_step(_loss_fn, tx2, mesh, donate=False)
+    for i in range(5, 5 + ctl.probation_steps):
+        state, loss = step2(state, _batch(rng))
+        assert ctl.watch(i, []) is None
+    assert np.isfinite(float(loss))
+    assert ctl.phase == "idle"
+    assert ctl.params["compressor"] == "powersgd"
+    names = [e["event"] for e in ctl.events]
+    assert names.index("retune_prepare") < names.index("retune_promote") \
+        < names.index("retune_probation_clear")
+
+
+def test_demotion_restores_last_known_good_bit_exactly(mesh, rng,
+                                                       tmp_path):
+    """A guard trip during probation demotes: the PREPARE-time checkpoint
+    comes back digest-identical and the incumbent config is restored."""
+    state, _ = _warm(mesh, rng)
+    ctl = _controller(tmp_path / "ckpt")
+    staged = ctl.prepare(4, state, mesh, NEW_PARAMS)
+    assert staged is not None
+    lkg = staged.lkg_digest
+    out = ctl.commit(4, mesh)
+    assert out is not None
+    state, (_, tx2), ev = out
+    step2 = make_train_step(_loss_fn, tx2, mesh, donate=False)
+    state, _ = step2(state, _batch(rng))
+
+    trig = ctl.watch(5, [{"event": "guard_skip", "step": 5}])
+    assert trig == "guard_skip"
+    restored, (_, tx3), dem = ctl.demote(5, state, mesh, trigger=trig)
+    assert dem["restored"] is True and dem["bit_exact"] is True
+    assert dem["trigger"] == "guard_skip"
+    assert state_digest(restored) == lkg
+    assert ctl.phase == "idle"
+    assert ctl.params["compressor"] == "homoqsgd"
+    # The demoted run keeps training under the incumbent config.
+    step3 = make_train_step(_loss_fn, tx3, mesh, donate=False)
+    restored, loss = step3(restored, _batch(rng))
+    assert np.isfinite(float(loss))
+    # prepare() mid-probation is a programming error, post-demote is fine.
+    assert ctl.prepare(6, restored, mesh, NEW_PARAMS) is not None
+
+
+def test_prepare_aborts_on_chain_structure_mismatch(mesh, rng):
+    """A build whose optimizer chain does not match the live state's
+    (guarded vs unguarded) aborts at the migrate gate — the incumbent
+    keeps running and the abort is recorded, not raised."""
+    state, _ = _warm(mesh, rng)
+
+    def unguarded(p):
+        grc = grace_from_params(p)
+        return grc, optax.chain(grc.transform(seed=0), optax.sgd(0.05))
+
+    ctl = RetuneController(build=unguarded, params=OLD_PARAMS,
+                           consensus=None, window=4)
+    assert ctl.prepare(4, state, mesh, NEW_PARAMS) is None
+    assert ctl.phase == "idle"
+    ev = ctl.events[-1]
+    assert ev["event"] == "retune_abort" and ev["leg"] == "migrate"
+
+
+def test_prepare_during_probation_raises(mesh, rng, tmp_path):
+    state, _ = _warm(mesh, rng)
+    ctl = _controller(tmp_path / "ckpt")
+    assert ctl.prepare(4, state, mesh, NEW_PARAMS) is not None
+    assert ctl.commit(4, mesh) is not None
+    with pytest.raises(RuntimeError, match="probation"):
+        ctl.prepare(5, state, mesh, NEW_PARAMS)
+
+
+def test_powersgd_ladder_states_padded_to_max_rank(mesh, rng):
+    """The rung-invariant comp-state layout: a PowerSGD ladder pads every
+    per-direction leaf to the LADDER's max rank so one ``lax.switch``
+    dispatches all rungs over one state shape."""
+    grc = grace_from_params({"compressor": "powersgd", "compress_rank": 2,
+                             "memory": "powersgd",
+                             "communicator": "allreduce",
+                             "escape": "fp16", "telemetry": 16,
+                             "adapt": {"window": 5,
+                                       "ladder": [{"compress_rank": 4}]}})
+    tx = optax.chain(grc.transform(seed=0), optax.sgd(0.05))
+    state = init_train_state(_mlp_params(rng), tx, mesh)
+    ranks = {leaf.shape[-1]
+             for g in _grace_nodes(state.opt_state)
+             for leaf in jax.tree_util.tree_leaves(g.comp)
+             if hasattr(leaf, "ndim") and leaf.ndim >= 2}
+    assert ranks == {4}, (
+        f"comp-state last-axis ranks {ranks}: every rung must share the "
+        "ladder max (4) so rank moves are mask flips, not reshapes")
+    step = make_train_step(_loss_fn, tx, mesh, donate=False)
+    for _ in range(3):
+        state, loss = step(state, _batch(rng))
+    assert np.isfinite(float(loss))

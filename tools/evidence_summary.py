@@ -18,7 +18,8 @@ Dedicated readers exist for (all repo-root, all optional):
   BENCH_ALL_CPU.json       per-algorithm CPU-mesh smoke sweep
   TPU_VARIANTS.jsonl       selection-variant session rows
   LINT_LAST.json / PROF_LAST.json / ELASTIC_LAST.json /
-  REGION_LAST.json / ADAPT_LAST.json / WATCH_LAST.json / TUNE_LAST.json
+  REGION_LAST.json / ADAPT_LAST.json / RETUNE_LAST.json /
+  WATCH_LAST.json / TUNE_LAST.json
 
 Usage: python tools/evidence_summary.py [--update-readme]
 Prints markdown to stdout; --update-readme splices it between the
@@ -510,6 +511,60 @@ def _sec_adapt(docs):
         + f" (`ADAPT_LAST.json`{', ' + when if when else ''})."]
 
 
+def _sec_retune(docs):
+    retune = docs("RETUNE_LAST.json")
+    if not (isinstance(retune, dict)
+            and retune.get("tool") == "chaos_smoke"):
+        return []
+    when = (retune.get("captured_at") or "").split("T")[0]
+    drift = retune.get("drift") or {}
+    fwd = retune.get("forward_promotion") or {}
+    sab = retune.get("sabotage") or {}
+    funnel = retune.get("funnel") or {}
+    mig = fwd.get("migration") or {}
+    mem = mig.get("mem") or {}
+    comp = mig.get("comp") or {}
+    bits = [
+        f"{retune.get('incumbent', '?')} → {retune.get('candidate', '?')} "
+        f"over window {retune.get('window', '?')} steps",
+        f"drift verdict at step {drift.get('verdict_step', '?')} "
+        f"(onset {drift.get('from_step', '?')})",
+    ]
+    if funnel:
+        bits.append(f"re-tune funnel winner `{funnel.get('winner', '?')}` "
+                    f"({len(funnel.get('measured') or [])} measured, "
+                    f"{len(funnel.get('skipped') or [])} skipped)")
+    if fwd:
+        variants = ("bit-identical"
+                    if fwd.get("replica_variants") == 1
+                    else f"{fwd.get('replica_variants')} variants")
+        bits.append(
+            f"two-phase promotion at step {fwd.get('step', '?')} "
+            f"(state migration carried {mem.get('carried', 0)}+"
+            f"{comp.get('carried', 0)} / overlap "
+            f"{mem.get('overlap', 0)}+{comp.get('overlap', 0)} / "
+            f"fresh {mem.get('fresh', 0)}+{comp.get('fresh', 0)}, "
+            f"replicas {variants})")
+    if sab:
+        within = ("inside probation" if sab.get("within_probation")
+                  else "OUTSIDE probation")
+        bit = ("bit-exact" if sab.get("bit_exact")
+               else "NOT bit-exact" if sab.get("restored")
+               else "NOT restored")
+        bits.append(
+            f"sabotaged promote → `{sab.get('trigger', '?')}` at step "
+            f"{sab.get('trigger_step', '?')} ({within}), demotion to "
+            f"last-known-good {bit}")
+    order = ("drift→prepare→promote→clear ordering holds"
+             if retune.get("ordering_ok")
+             else "ORDERING VIOLATED")
+    bits.append(order)
+    return [
+        "Online re-tuning (graft-retune): `chaos_smoke --retune` → "
+        + ", ".join(bits)
+        + f" (`RETUNE_LAST.json`{', ' + when if when else ''})."]
+
+
 def _sec_watch(docs):
     watch = docs("WATCH_LAST.json")
     if not (isinstance(watch, dict)
@@ -590,6 +645,7 @@ _SECTIONS = (
     ("ELASTIC_LAST.json", _sec_elastic),
     ("REGION_LAST.json", _sec_region),
     ("ADAPT_LAST.json", _sec_adapt),
+    ("RETUNE_LAST.json", _sec_retune),
     ("WATCH_LAST.json", _sec_watch),
     ("TUNE_LAST.json", _sec_tune),
 )
