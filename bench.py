@@ -239,7 +239,12 @@ PROJECTION_MODEL = {
         "speedup_vs_dense is an OPTIMISTIC bound for compression wherever "
         "wire dominates and both get pessimistic step times. Measure the "
         "realized overlap fraction from a device trace with "
-        "tools/perf_report.py (grace_tpu.profiling) to close the gap."),
+        "tools/perf_report.py (grace_tpu.profiling) to close the gap. ONE "
+        "declared exception: a double-buffered communicator (pipeline=P "
+        "on ring/hier) discounts its own wire leg by its "
+        "wire_overlap_fraction() — a claim flow pass 5 referees "
+        "statically (the traced graph must expose >= P independent "
+        "chains) and the row stamps as wire_pipeline_overlap."),
     "per_link": (
         f"each row's xslice block splits received bytes by link class via "
         f"Communicator.recv_link_bytes under a Topology(slice_size="
@@ -298,15 +303,25 @@ def project_multichip(step_s: float, dense_step_s: float, grace,
     vote = getattr(grace.compressor, "vote_aggregate", False)
     dense_comm = Allreduce()
     xtopo = Topology(slice_size=XSLICE_CHIPS)
+    # wire_pipeline discount (ISSUE 19): the ONE exception to the
+    # NO-OVERLAP assumption — a double-buffered communicator (pipeline=P
+    # on ring/hier) declares its own overlap fraction
+    # (WIRE_PIPELINE_EFFICIENCY · (P−1)/P), statically refereed by flow
+    # pass 5's >= P independent-chain requirement, so only its wire leg is
+    # scaled by (1 − overlap). Dense always keeps the undiscounted bound.
+    keep = 1.0 - float(getattr(grace.communicator, "wire_overlap_fraction",
+                               lambda: 0.0)())
     out = []
     for w in PROJECTION_WORLDS:
         cfg_recv = recv_bytes_model(grace.communicator, vote, wire_b,
                                     n_elems, w)
         dense_recv = dense_comm.recv_wire_bytes(dense_b, n_elems, w)
         row = {"world": w, "recv_bytes_per_rank": cfg_recv}
+        if keep < 1.0:
+            row["wire_pipeline_overlap"] = round(1.0 - keep, 6)
         for net, bw in (("ici", ICI_RING_BYTES_PER_S),
                         ("dcn", DCN_BYTES_PER_S)):
-            t_cfg = step_s + cfg_recv / bw
+            t_cfg = step_s + cfg_recv / bw * keep
             t_dense = dense_step_s + dense_recv / bw
             row[f"step_ms_{net}"] = round(t_cfg * 1e3, 3)
             row[f"speedup_vs_dense_{net}"] = round(t_dense / t_cfg, 3)
@@ -315,11 +330,11 @@ def project_multichip(step_s: float, dense_step_s: float, grace,
         dense_link = dense_comm.recv_link_bytes(
             dense_b, n_elems, w, topology=xtopo)
 
-        def t_split(base_s, link):
-            return (base_s + link.ici / ICI_RING_BYTES_PER_S
-                    + link.dcn / DCN_BYTES_PER_S)
+        def t_split(base_s, link, keep=1.0):
+            return (base_s + (link.ici / ICI_RING_BYTES_PER_S
+                              + link.dcn / DCN_BYTES_PER_S) * keep)
 
-        t_cfg = t_split(step_s, cfg_link)
+        t_cfg = t_split(step_s, cfg_link, keep)
         row["xslice"] = {
             "slice_size": XSLICE_CHIPS,
             "ici_bytes": cfg_link.ici,
@@ -692,6 +707,20 @@ def bench_configs(platform: str, configs, emit) -> None:
         # headline must be distinguishable row-by-row, the same honesty
         # contract as pallas_enabled.
         row_extra["fusion"] = ent.grace.fusion
+        # Wire-path provenance (ISSUE 19), same honesty contract as
+        # fusion/pallas_enabled: the packed field width the payload
+        # actually ships (absent for byte-wide formats) and the
+        # communicator's pipeline depth — a pipelined capture and its
+        # serial twin, or a 2-bit and a 4-bit row, must be
+        # distinguishable row-by-row.
+        _comp = ent.grace.compressor
+        if getattr(_comp, "packed_wire", False):
+            row_extra["pack_width"] = int(_comp.pack_width)
+        elif getattr(_comp, "accum_bits", None):
+            row_extra["pack_width"] = int(_comp.accum_bits)
+        _pipe = int(getattr(ent.grace.communicator, "pipeline", 1) or 1)
+        if _pipe > 1:
+            row_extra["pipelined"] = _pipe
         if cfg.get("note"):
             # Config-level caveat (e.g. "bf16 grads use the staged Top-K
             # path") — evidence rows must carry their own context.

@@ -208,6 +208,35 @@ CONFIGS = [
      "params": {"compressor": "qsgd", "quantum_num": 7,
                 "use_pallas": False, "memory": "none",
                 "communicator": "ring", "fusion": 1024}},
+    # Sub-nibble wire widths (ISSUE 19): quantum_num=1 ships 2-bit fields
+    # (4 codes/byte — 16x under int8, 2x under the 4-bit nibble) and
+    # quantum_num=3 the 3-bit LSB-first bitstream (8 codes / 3 bytes),
+    # both through the hop-requant ring. Rows stamp pack_width so the
+    # 2/3/4-bit family is distinguishable in the evidence; the quality
+    # cost of the coarser lattice is the convergence suite's question,
+    # the wire win is this sweep's.
+    {"name": "qsgd2_packed_ring_bs256", "per_device_bs": 256,
+     "params": {"compressor": "qsgd", "quantum_num": 1,
+                "use_pallas": False, "memory": "none",
+                "communicator": "ring", "fusion": "flat"}},
+    {"name": "qsgd3_packed_ring_bs256", "per_device_bs": 256,
+     "params": {"compressor": "qsgd", "quantum_num": 3,
+                "use_pallas": False, "memory": "none",
+                "communicator": "ring", "fusion": "flat"}},
+    # Double-buffered ring twins (ISSUE 19): pipeline=2 splits the flat
+    # buffer into two segments whose ring schedules overlap on real links
+    # — the delta against the serial siblings above is the measured side
+    # of the wire_pipeline story (rows stamp pipelined=2, projections
+    # discount the wire leg by wire_overlap_fraction, and flow pass 5
+    # referees the >= 2 independent chains statically).
+    {"name": "qsgd2_packed_ring_pipelined_bs256", "per_device_bs": 256,
+     "params": {"compressor": "qsgd", "quantum_num": 1,
+                "use_pallas": False, "memory": "none",
+                "communicator": "ring", "fusion": "flat", "pipeline": 2}},
+    {"name": "qsgd4_packed_ring_pipelined_bs256", "per_device_bs": 256,
+     "params": {"compressor": "qsgd", "quantum_num": 7,
+                "use_pallas": False, "memory": "none",
+                "communicator": "ring", "fusion": "flat", "pipeline": 2}},
     # qsgd vs qsgd_pallas: THE evidence gate for flipping QSGD's
     # use_pallas default (VERDICT r3 item 5, two rounds dark).
     # use_pallas pinned False: this row is the STAGED side of the
@@ -368,7 +397,14 @@ TUNED_ROW_NAMES = ("none", "topk1pct", "topk1pct_hier_bs256", "qsgd_hier",
                    # controller-overhead ablation the acceptance
                    # criterion ("matches the best static config's
                    # steady-state throughput") needs on-chip
-                   "adapt_homoqsgd4_ring_bs256")
+                   "adapt_homoqsgd4_ring_bs256",
+                   # graft-wire (ISSUE 19): the 2/3-bit pack widths and
+                   # the double-buffered ring twins — the serial vs
+                   # pipelined deltas are the measured side of the
+                   # wire_pipeline discount
+                   "qsgd2_packed_ring_bs256", "qsgd3_packed_ring_bs256",
+                   "qsgd2_packed_ring_pipelined_bs256",
+                   "qsgd4_packed_ring_pipelined_bs256")
 
 
 def active_configs():

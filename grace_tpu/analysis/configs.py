@@ -35,9 +35,18 @@ _NO_WIRE = tuple(p for p in PASS_NAMES if p != "wire_reconciliation")
 
 
 def _cfg(name: str, params: Dict[str, Any], *, passes=_ALL, mode="update",
-         guard=None, consensus=None, fsdp=None) -> Dict[str, Any]:
+         guard=None, consensus=None, fsdp=None,
+         world=None) -> Dict[str, Any]:
+    # world: per-entry audit-mesh override. Most entries trace at the
+    # caller's world (8 by default); configs whose payload accumulator
+    # legitimately bounds the world — e.g. packed sub-byte homoqsgd,
+    # whose payload_sum_max_world is (2^(bits-1)-1)//quantum_num — pin
+    # the world their contract actually supports, so the registry stays
+    # lint-clean while the out-of-bound worlds remain the rejection
+    # demonstrators tests pin explicitly.
     return {"name": name, "params": params, "passes": passes, "mode": mode,
-            "guard": guard, "consensus": consensus, "fsdp": fsdp}
+            "guard": guard, "consensus": consensus, "fsdp": fsdp,
+            "world": world}
 
 
 AUDIT_CONFIGS: List[Dict[str, Any]] = [
@@ -309,6 +318,59 @@ AUDIT_CONFIGS: List[Dict[str, Any]] = [
     _cfg("signsgd-pallas-packed", {"compressor": "signsgd",
                                    "use_pallas": True, "memory": "none",
                                    "communicator": "allgather"}),
+    # -- kernel-resident wire path (ISSUE 19) -------------------------------
+    # 2-bit packed qsgd (quantum_num=1 → pack_width 2, 4 codes/byte)
+    # through the double-buffered ring: pipeline=2 splits the flat buffer
+    # into two segments whose full ring schedules trace as independent
+    # compress→exchange chains — flow pass 5 counts them via the
+    # grace/pipeline/<p> scope tags and requires >= pipeline chains, the
+    # static referee for the runtime overlap the wire_pipeline discount
+    # prices. Pack-width 2 is re-verified by pass 6's sub-byte audit.
+    _cfg("qsgd2-ring-packed-pipelined", {"compressor": "qsgd",
+                                         "quantum_num": 1,
+                                         "use_pallas": False,
+                                         "memory": "none",
+                                         "communicator": "ring",
+                                         "fusion": "flat", "pipeline": 2}),
+    # Packed-wire homomorphic ring with the fused payload accumulate
+    # traced INSIDE the audited graph (use_pallas=True → the interpret-
+    # mode packed_int_accumulate kernel runs at every hop and the final
+    # gather-sum): accum_bits=4 makes the 4-bit two's-complement field
+    # BOTH the wire word and the hop accumulator, so
+    # payload_sum_max_world tightens to (2^3 - 1)//quantum_num = 7 —
+    # this entry audits at world=4 (inside the bound). The 8-way default
+    # would fire the static accumulator finding AND the communicators'
+    # runtime gate from the same constant, which is exactly the
+    # graduated-rejection contract tests/test_wire.py pins at 2 bits.
+    _cfg("homoqsgd4-ring-fused", {"compressor": "homoqsgd",
+                                  "quantum_num": 1, "accum_bits": 4,
+                                  "use_pallas": True, "memory": "residual",
+                                  "communicator": "ring",
+                                  "fusion": "flat"}, world=4),
+    # The fused decode→accumulate boundary kernel inside the two-level
+    # schedule: packed 4-bit qsgd through hier's intra-slice hop requants
+    # AND the cross-slice boundary, with use_pallas=True swapping the
+    # boundary's staged vmap-decompress + aggregate for the fused K-way
+    # decode_accumulate pass (wire_fused() live) — the interpret-mode
+    # pallas_call equations trace inside the audited graph, proving the
+    # kernel-resident boundary is auditable end to end.
+    _cfg("hier-fused-boundary", {"compressor": "qsgd", "quantum_num": 7,
+                                 "use_pallas": True, "memory": "none",
+                                 "communicator": "hier", "slice_size": 4,
+                                 "fusion": "flat"}),
+    # The fused-boundary schedule's train-mode twin under the full
+    # resilience stack: the same packed qsgd + interpret-mode wire
+    # kernels, now inside the guarded train step with the consensus audit
+    # fingerprinting downstream — the pallas_call equations sit inside
+    # the escape cond's compressed branch, and collective_consistency /
+    # bit_exactness must bless the kernel-resident path exactly as they
+    # bless the staged one.
+    _cfg("hier-fused-boundary-guard-consensus",
+         {"compressor": "qsgd", "quantum_num": 7, "use_pallas": True,
+          "memory": "none", "communicator": "hier", "slice_size": 4,
+          "fusion": "flat", "escape": "fp16", "consensus": True},
+         passes=_NO_WIRE, mode="train",
+         guard={"fallback_after": 3, "fallback_steps": 8}, consensus=True),
     # -- graft-watch variants (ISSUE 8): the watch summary adds a lax.cond
     #    (window-boundary predicate from the replicated step counter) whose
     #    taken branch issues an all_gather the untaken branch lacks — the
@@ -537,6 +599,7 @@ def overlap_bound_report(entry: Dict[str, Any], *, world: int = 8
     if entry.get("mode", "update") != "update" \
             or isinstance(fusion, bool) or not isinstance(fusion, int):
         return None
+    world = int(entry.get("world") or world)
     grace = entry.get("grace") or build_grace(entry)
     traced = trace_update(grace, world=world, name=entry["name"],
                           meta={"grace": grace})
@@ -557,6 +620,7 @@ def audit_config(entry: Dict[str, Any], *, world: int = 8
     exceptions — a config that stops tracing at all is itself a finding."""
     name = entry["name"]
     passes = tuple(entry.get("passes") or PASS_NAMES)
+    world = int(entry.get("world") or world)
     grace = entry.get("grace") or build_grace(entry)
     meta = {"grace": grace, "params": entry.get("params")}
     try:

@@ -112,19 +112,26 @@ def _raw_stack(eqn) -> str:
 
 
 _BUCKET_RE = None
+_PIPE_RE = None
 
 
 def _bucket_of(eqn) -> Optional[str]:
-    """The ``grace/bucket/<b>`` scope id an equation was traced under, or
-    None — the bucketed executor's per-pipeline tag."""
-    global _BUCKET_RE
+    """The chain-scope id an equation was traced under, or None: the
+    bucketed executor's ``grace/bucket/<b>`` tag, the ring schedules'
+    double-buffered ``grace/pipeline/<p>`` segment tag, or both joined —
+    each (bucket, segment) pair is its own independent collective chain,
+    which is exactly how the chain counting must group heads."""
+    global _BUCKET_RE, _PIPE_RE
     if _BUCKET_RE is None:
         import re
 
-        from grace_tpu.telemetry.scopes import STAGE_BUCKET
+        from grace_tpu.telemetry.scopes import STAGE_BUCKET, STAGE_PIPELINE
         _BUCKET_RE = re.compile(re.escape(STAGE_BUCKET) + r"/(\d+)")
-    m = _BUCKET_RE.search(_raw_stack(eqn))
-    return m.group(0) if m else None
+        _PIPE_RE = re.compile(re.escape(STAGE_PIPELINE) + r"/(\d+)")
+    stack = _raw_stack(eqn)
+    tags = [m.group(0) for m in (_BUCKET_RE.search(stack),
+                                 _PIPE_RE.search(stack)) if m]
+    return "|".join(tags) if tags else None
 
 
 # ---------------------------------------------------------------------------
@@ -327,15 +334,23 @@ def _expected_chains(traced: TracedGraph) -> Optional[int]:
     grace = traced.meta.get("grace")
     if grace is None:
         return None
+    # The double-buffered ring schedules multiply every bucket's chains by
+    # their segment count: P segments each run the whole hop schedule
+    # under their own grace/pipeline/<p> scope, independent by
+    # construction (contiguous buffer slices). Even flat fusion — one
+    # bucket — must then expose P chains, which is the whole point of
+    # pipeline > 1; fewer means the segments serialized.
+    pipeline = int(getattr(getattr(grace, "communicator", None),
+                           "pipeline", 1) or 1)
     fusion = getattr(grace, "fusion", None)
     if not isinstance(fusion, int) or isinstance(fusion, bool):
-        return None
+        return pipeline if pipeline > 1 else None
     from grace_tpu.transform import _bucketize
 
     structs = _param_structs(traced)
     buckets, _ = _bucketize([(s.shape, s.dtype) for s in structs],
                             int(fusion))
-    return len(buckets)
+    return len(buckets) * pipeline
 
 
 def _param_structs(traced: TracedGraph) -> List[jax.ShapeDtypeStruct]:

@@ -29,7 +29,8 @@ from jax import lax
 from grace_tpu.core import (Communicator, Compressor, Ctx, LinkBytes,
                             Payload, SINGLE_SLICE, Topology, axis_size)
 from grace_tpu.telemetry.scopes import (STAGE_DECOMPRESS, STAGE_EXCHANGE,
-                                        STAGE_RING_HOP, trace_stage)
+                                        STAGE_PIPELINE, STAGE_RING_HOP,
+                                        trace_stage)
 
 __all__ = ["Allreduce", "Allgather", "Broadcast", "Identity",
            "SignAllreduce", "TwoShotAllreduce", "RingAllreduce",
@@ -73,6 +74,46 @@ def vote_exact_max_world(vote_dtype) -> int:
 # proven-clean programs byte-identical.
 _PSUM_CHUNK_ELEMS = 8_388_608          # 32 MiB of f32 per collective chunk
 _PSUM_CHUNK_THRESHOLD = 33_554_432     # chunk only oversized 1-D payloads
+
+# Fraction of a pipelined segment's wire time the tuner may credit as
+# hidden behind the neighbouring segment's compute (stage-1 encode /
+# hop decode-accumulate-requant). Deliberately conservative: a 2-segment
+# double buffer can at best hide min(compute, wire) of every inner
+# boundary, and the hop kernels are far cheaper than the ppermute they
+# overlap, so crediting half of the steady-state (P-1)/P overlap keeps
+# the projection honest until a measured trace replaces it. ONE constant:
+# ``wire_overlap_fraction`` here, the tuner's ``wire_pipeline`` discount
+# (tuning/cost.py), and the bench projections all read it.
+WIRE_PIPELINE_EFFICIENCY = 0.5
+
+
+def _pipeline_segments(n: int, pipeline: int) -> list[tuple[int, int]]:
+    """Static ``[lo, hi)`` bounds of the ``pipeline`` contiguous segments a
+    flat ``n``-element buffer is split into by the double-buffered ring
+    schedule. Equal ``ceil(n/P)`` segments (the last may be shorter);
+    clamped so no segment is empty — tiny buffers simply pipeline less."""
+    p = max(1, min(int(pipeline), n if n else 1))
+    per = -(-n // p)
+    return [(lo, min(lo + per, n)) for lo in range(0, max(n, 1), per)]
+
+
+@dataclasses.dataclass(frozen=True)
+class _PipelinedView:
+    """Decompress-only adapter over P per-segment :class:`_ChunkedView`
+    ctxs: each segment's stacked shard payloads decode and reassemble
+    independently, then concatenate back into the full leaf — so every
+    Memory's ``update`` sees one reconstruction of the whole buffer and
+    the error-feedback contract is unchanged by pipelining."""
+
+    inner: Compressor
+
+    def decompress(self, payload: Payload, ctx) -> jax.Array:
+        seg_ctxs, n, shape, dtype = ctx
+        view = _ChunkedView(self.inner)
+        parts = [view.decompress(p, c).reshape(-1)
+                 for p, c in zip(payload, seg_ctxs)]
+        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        return flat[:n].reshape(shape).astype(dtype)
 
 
 def _psum(t: jax.Array, axis_name: str) -> jax.Array:
@@ -468,6 +509,35 @@ def _shard_compress(compressor: Compressor, chunks: jax.Array,
     return payloads, ctx_arrays, treedef, static
 
 
+def _gathered_aggregate(base: Compressor, codec: Compressor, stacked,
+                        ctx, k: int) -> jax.Array:
+    """Aggregate ``k`` gathered wire payloads (leading axis ``k`` on every
+    leaf) that share one data-free ``ctx`` — the requant boundaries'
+    decode-and-reduce, shared by ReduceScatterAllreduce's owned chunk and
+    HierarchicalAllreduce's slice/region boundaries. When ``codec``
+    overrides :meth:`Compressor.decode_accumulate` (the wire-path codecs:
+    qsgd/signsgd) the decode and the accumulate run as ONE fused pass —
+    the payloads never materialise densely — and the singleton
+    ``aggregate`` re-signs vote tallies exactly like the ring's final
+    hop; otherwise the staged vmap-decompress + aggregate spelling runs
+    unchanged. ``base`` supplies the aggregation semantics (sum or
+    majority vote) even when a distinct WAN ``codec`` did the encode.
+
+    The fused spelling engages only when the codec's wire kernels are
+    LIVE (``codec.wire_fused()``): the K-way fused pass accumulates
+    sequentially while the staged ``aggregate`` reduces with ``jnp.sum``,
+    and float adds are not associative — with the kernel disabled the
+    committed staged spelling must keep running bit-for-bit."""
+    if (codec.wire_fused()
+            and type(codec).decode_accumulate
+            is not Compressor.decode_accumulate):
+        parts = tuple(tuple(t[j] for t in stacked) for j in range(k))
+        partial = codec.decode_accumulate(parts, (ctx,) * k)
+        return base.aggregate(partial[None])
+    decoded = jax.vmap(lambda p: codec.decompress(p, ctx))(stacked)
+    return base.aggregate(decoded)
+
+
 @dataclasses.dataclass(frozen=True)
 class TwoShotAllreduce(Communicator):
     """Scatter–reduce–(re)compress all-reduce: O(k) wire per rank.
@@ -667,14 +737,48 @@ class RingAllreduce(Communicator):
     The hop loop is unrolled at trace time (W−1 ppermutes of statically
     shaped payloads) — compile cost grows with W, the trade XLA's static
     ring collectives make themselves.
+
+    **Double-buffered wire pipeline** (``pipeline=P > 1``): the flat
+    buffer splits into P contiguous segments and each segment runs the
+    WHOLE schedule above under its own ``grace/pipeline/<p>`` scope and
+    rng fold — P independent collective chains, so XLA can overlap
+    segment p's ppermute hops with segment p±1's encode/decode compute
+    (the classic double buffer at P=2). Pure schedule restructuring:
+    per-segment error feedback reassembles to the full buffer
+    (:class:`_PipelinedView`), the static overlap auditor (flow pass 5)
+    counts the chains, and the tuner credits
+    ``wire_overlap_fraction`` = ``WIRE_PIPELINE_EFFICIENCY·(P−1)/P`` of
+    the wire bill. ``pipeline=1`` is the committed single-chain schedule
+    bit-for-bit. Segmentation DOES change the stochastic encodes (each
+    segment folds its own keys), so a pipelined config is a different —
+    equally valid — draw of the same estimator, not a bit-twin of its
+    serial sibling.
     """
 
+    pipeline: int = 1
     shard_parallel = True
+
+    def __post_init__(self):
+        if self.pipeline < 1:
+            raise ValueError(
+                f"RingAllreduce pipeline must be >= 1; got {self.pipeline} "
+                "— it is the number of double-buffered buffer segments, "
+                "each running the full hop schedule.")
+
+    def wire_overlap_fraction(self) -> float:
+        p = self.pipeline
+        if p <= 1:
+            return 0.0
+        return WIRE_PIPELINE_EFFICIENCY * (p - 1) / p
 
     def _recv_total_bytes(self, payload_nbytes: int, n_elems: int,
                           world: int, vote: bool = False) -> int:
         # (W-1) reduce-scatter hop payloads + (W-1) gathered shard
         # payloads, each ~payload/W: ≈ 2·payload·(W-1)/W, flat in W.
+        # Pipeline-invariant: P segments each move the same formula over
+        # 1/P of the buffer; per-segment shard padding adds at most
+        # P·(W-1) extra elements — inside the wire-reconciliation
+        # tolerance, so the scalar model stays the serial one.
         return 2 * payload_nbytes * max(0, world - 1) // max(1, world)
 
     def step(self, x: jax.Array, mem_state, comp_state,
@@ -704,30 +808,75 @@ class RingAllreduce(Communicator):
         compensated, mem_state = memory.compensate(x, mem_state)
         flat = compensated.reshape(-1)
         n = flat.size
-        w, _, pad = self.shard_spec(n)              # static at trace time
         if homo:
-            _check_payload_sum_world(compressor, w, "RingAllreduce")
-        chunks = jnp.pad(flat, (0, pad)).reshape(w, -1)
+            _check_payload_sum_world(compressor, axis_size(self.axis_name),
+                                     "RingAllreduce")
 
         # Shared-scale negotiation, hoisted before stage 1 over the WHOLE
-        # buffer (one per-bucket scale, not per shard): every shard then
-        # encodes against the identical replicated scale, so hop sums are
-        # exact and error feedback covers this single encode.
+        # buffer (one per-bucket scale, not per shard or per pipeline
+        # segment): every shard then encodes against the identical
+        # replicated scale, so hop sums are exact and error feedback
+        # covers this single encode.
         shared = None
         if algebra == "shared_scale":
             with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
                 shared = compressor.negotiate(flat, self.axis_name,
                                               rng=rng)
 
+        segs = _pipeline_segments(n, self.pipeline)
+        if len(segs) == 1:
+            out, payloads, ctx_arrays, treedef, static = \
+                self._segment_schedule(flat, compressor, rng, shared,
+                                       homo, exact)
+            # Error feedback covers the stage-1 encode exactly (the hop
+            # requant losses are downstream of it, like two-shot's
+            # stage-2 loss).
+            view_ctx = (treedef, static, ctx_arrays, n, shape, dtype, None)
+            mem_state = memory.update(compensated, payloads, view_ctx,
+                                      _ChunkedView(compressor), mem_state)
+        else:
+            # Double-buffered schedule: every contiguous segment runs the
+            # WHOLE ring under its own pipeline scope and rng fold — P
+            # independent collective chains XLA can interleave, so
+            # segment p's ppermutes hide behind segment p±1's
+            # encode/decode compute. Error feedback still covers the
+            # full-buffer stage-1 encode: the per-segment reconstructions
+            # concatenate through _PipelinedView.
+            outs, seg_pay, seg_ctx = [], [], []
+            for p, (lo, hi) in enumerate(segs):
+                with trace_stage(f"{STAGE_PIPELINE}/{p}"):
+                    o, pay, arrs, treedef, static = \
+                        self._segment_schedule(
+                            flat[lo:hi], compressor,
+                            jax.random.fold_in(rng, p), shared, homo,
+                            exact)
+                outs.append(o)
+                seg_pay.append(pay)
+                seg_ctx.append((treedef, static, arrs, hi - lo,
+                                (hi - lo,), flat.dtype, None))
+            out = jnp.concatenate(outs)
+            view_ctx = (tuple(seg_ctx), n, shape, dtype)
+            mem_state = memory.update(compensated, tuple(seg_pay),
+                                      view_ctx, _PipelinedView(compressor),
+                                      mem_state)
+        out = out[:n].reshape(shape).astype(dtype)
+        return out, mem_state, comp_state
+
+    def _segment_schedule(self, flat, compressor: Compressor,
+                          rng: jax.Array, shared, homo: bool, exact: bool):
+        """One full ring schedule over one contiguous flat segment — the
+        stage-1 shard encode, the W−1 hops, the gather and the decode,
+        shared verbatim by the single-segment run (``pipeline=1``: the
+        committed path bit-for-bit) and the pipelined segments. Returns
+        ``(decoded flat segment, stage-1 payloads, ctx arrays, treedef,
+        static)`` so the caller wires error feedback."""
+        n = flat.shape[0]
+        w, _, pad = self.shard_spec(n)              # static at trace time
+        chunks = jnp.pad(flat, (0, pad)).reshape(w, -1)
+
         with trace_stage(f"{STAGE_EXCHANGE}/ring_stage1_compress"):
             payloads, ctx_arrays, treedef, static = _shard_compress(
                 compressor, chunks, rng, "RingAllreduce", shared=shared)
-
-        # Error feedback covers the stage-1 encode exactly (the hop requant
-        # losses are downstream of it, like two-shot's stage-2 loss).
-        view_ctx = (treedef, static, ctx_arrays, n, shape, dtype, None)
-        mem_state = memory.update(compensated, payloads, view_ctx,
-                                  _ChunkedView(compressor), mem_state)
 
         i = lax.axis_index(self.axis_name)
         perm = [(j, (j + 1) % w) for j in range(w)]
@@ -752,7 +901,12 @@ class RingAllreduce(Communicator):
                     recv = tuple(lax.ppermute(t, self.axis_name, perm)
                                  for t in send)
                     own = take_payload(payloads, (i - 2 - s) % w)
-                    send = tuple(r + o for r, o in zip(recv, own))
+                    # payload_add is the codec's payload-space add —
+                    # elementwise for plain wire words (the committed
+                    # spelling bit-for-bit), a packed-field add (fused
+                    # Pallas accumulate) for sub-byte homomorphic
+                    # payloads that a byte-wise ``+`` would corrupt.
+                    send = compressor.payload_add(recv, own)
             owned = send                 # wire-format reduction of shard i
             if compressor.average and not homo:
                 if not all(jnp.issubdtype(t.dtype, jnp.inexact)
@@ -797,10 +951,14 @@ class RingAllreduce(Communicator):
                     # produced identical (data-free) ctx arrays, so the
                     # local hop_ctx decodes the neighbor's payload.
                     rctx = shard_ctx(rc) if s == 0 else hop_ctx
-                    partial = (compressor.decompress(recv, rctx)
-                               + compressor.decompress(
-                                   take_payload(payloads, rc),
-                                   shard_ctx(rc)))
+                    # decode_accumulate defaults to the committed
+                    # sequential decompress-and-add spelling; wire-path
+                    # codecs (qsgd/signsgd) override it with ONE fused
+                    # Pallas decode→accumulate pass, bit-identical by the
+                    # tests' contract.
+                    partial = compressor.decode_accumulate(
+                        (recv, take_payload(payloads, rc)),
+                        (rctx, shard_ctx(rc)))
                     if s < w - 2:
                         pay, hop_ctx, _ = compressor.compress(
                             partial, None,
@@ -825,8 +983,7 @@ class RingAllreduce(Communicator):
             with trace_stage(STAGE_DECOMPRESS):
                 out = jax.vmap(
                     lambda p: compressor.decompress(p, ctx2))(gathered)
-        out = out.reshape(-1)[:n].reshape(shape).astype(dtype)
-        return out, mem_state, comp_state
+        return out.reshape(-1)[:n], payloads, ctx_arrays, treedef, static
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:
@@ -964,10 +1121,13 @@ class ReduceScatterAllreduce(Communicator):
 
         if exact:
             # Payload-space reduction of the owned chunk: the wire format
-            # IS the accumulator (dtype pinned so integer level sums stay
-            # in the declared accumulator width), and phase 2 gathers the
-            # summed wire words themselves — zero requant at any W.
-            owned = tuple(jnp.sum(t, axis=0, dtype=t.dtype) for t in mine)
+            # IS the accumulator, and phase 2 gathers the summed wire
+            # words themselves — zero requant at any W. payload_sum is
+            # the codec's stacked payload-space reduction: the committed
+            # dtype-pinned jnp.sum for plain wire words (integer level
+            # sums stay in the declared accumulator width), the fused
+            # packed-field accumulate for sub-byte homomorphic payloads.
+            owned = compressor.payload_sum(mine)
             if compressor.average and not homo:
                 if not all(jnp.issubdtype(t.dtype, jnp.inexact)
                            for t in owned):
@@ -999,11 +1159,11 @@ class ReduceScatterAllreduce(Communicator):
             # owned chunk with the locally derived (data-free) ctx,
             # aggregate — a true ONE-SHOT sum/majority vote, not the
             # ring's cascaded one — and re-encode exactly once under a
-            # shared key every rank can decode.
+            # shared key every rank can decode. _gathered_aggregate fuses
+            # the decode+reduce into one kernel pass for wire-path codecs.
             my_ctx = shard_ctx(i)
-            stacked = jax.vmap(
-                lambda p: compressor.decompress(p, my_ctx))(mine)
-            agg = compressor.aggregate(stacked)
+            agg = _gathered_aggregate(compressor, compressor, mine,
+                                      my_ctx, w)
             if compressor.average:
                 agg = agg / w
             payload2, ctx2, _ = compressor.compress(
@@ -1101,9 +1261,17 @@ class HierarchicalAllreduce(Communicator):
     slice_size: Optional[int] = None
     region_size: Optional[int] = None
     wan_compressor: Optional[Compressor] = None
+    pipeline: int = 1
     shard_parallel = True
 
     def __post_init__(self):
+        if self.pipeline < 1:
+            raise ValueError(
+                "HierarchicalAllreduce pipeline must be >= 1; got "
+                f"{self.pipeline} — it is the number of double-buffered "
+                "buffer segments, each running the full multi-level "
+                "schedule (the RingAllreduce.pipeline semantics applied "
+                "to the intra-slice ring and both boundary exchanges).")
         if self.slice_size is not None and self.slice_size < 1:
             raise ValueError(f"slice_size must be >= 1 or None; "
                              f"got {self.slice_size}")
@@ -1141,6 +1309,12 @@ class HierarchicalAllreduce(Communicator):
         return dataclasses.replace(self, slice_size=topology.slice_size,
                                    region_size=topology.region_size,
                                    wan_compressor=wan)
+
+    def wire_overlap_fraction(self) -> float:
+        p = self.pipeline
+        if p <= 1:
+            return 0.0
+        return WIRE_PIPELINE_EFFICIENCY * (p - 1) / p
 
     def _split(self, world: int) -> tuple[int, int]:
         """(intra-slice size S, slice count K) for this world. Static."""
@@ -1306,30 +1480,72 @@ class HierarchicalAllreduce(Communicator):
         compensated, mem_state = memory.compensate(x, mem_state)
         flat = compensated.reshape(-1)
         n = flat.size
-        pad = (-n) % s
-        chunks = jnp.pad(flat, (0, pad)).reshape(s, -1)
 
         # Shared-scale negotiation hoisted before stage 1: ONE full-axis
-        # pmax (not per slice — a per-slice scale would break the
-        # cross-slice payload sum), so the boundary exchange stays a pure
-        # integer add with zero requant regardless of K.
+        # pmax (not per slice or per pipeline segment — a per-slice scale
+        # would break the cross-slice payload sum), so the boundary
+        # exchange stays a pure integer add with zero requant regardless
+        # of K.
         shared = None
         if algebra == "shared_scale":
             with trace_stage(f"{STAGE_EXCHANGE}/negotiate_scale"):
                 shared = compressor.negotiate(flat, self.axis_name,
                                               rng=rng)
 
+        segs = _pipeline_segments(n, self.pipeline)
+        if len(segs) == 1:
+            out, payloads, ctx_arrays, treedef, static = \
+                self._segment_schedule(flat, compressor, rng, shared,
+                                       homo, exact, w, s, kr, r)
+            # Error feedback covers the stage-1 shard encode exactly; the
+            # intra-slice hop requants and the boundary re-encodes are
+            # downstream of it (same contract as Ring/TwoShot).
+            view_ctx = (treedef, static, ctx_arrays, n, shape, dtype, None)
+            mem_state = memory.update(compensated, payloads, view_ctx,
+                                      _ChunkedView(compressor), mem_state)
+        else:
+            # Double-buffered schedule (RingAllreduce.pipeline semantics):
+            # each contiguous segment runs the WHOLE multi-level schedule
+            # under its own pipeline scope and rng fold, so the
+            # intra-slice ppermutes and both boundary gathers of segment p
+            # can hide behind segment p±1's encode/decode compute.
+            outs, seg_pay, seg_ctx = [], [], []
+            for p, (lo, hi) in enumerate(segs):
+                with trace_stage(f"{STAGE_PIPELINE}/{p}"):
+                    o, pay, arrs, treedef, static = \
+                        self._segment_schedule(
+                            flat[lo:hi], compressor,
+                            jax.random.fold_in(rng, p), shared, homo,
+                            exact, w, s, kr, r)
+                outs.append(o)
+                seg_pay.append(pay)
+                seg_ctx.append((treedef, static, arrs, hi - lo,
+                                (hi - lo,), flat.dtype, None))
+            out = jnp.concatenate(outs)
+            view_ctx = (tuple(seg_ctx), n, shape, dtype)
+            mem_state = memory.update(compensated, tuple(seg_pay),
+                                      view_ctx, _PipelinedView(compressor),
+                                      mem_state)
+        out = out[:n].reshape(shape).astype(dtype)
+        return out, mem_state, comp_state
+
+    def _segment_schedule(self, flat, compressor: Compressor,
+                          rng: jax.Array, shared, homo: bool, exact: bool,
+                          w: int, s: int, kr: int, r: int):
+        """One full multi-level schedule over one contiguous flat segment
+        — stage-1 encode, S−1 intra-slice hops, the slice/region boundary
+        exchanges, the gather and the decode — shared verbatim by the
+        single-segment run (``pipeline=1``: the committed path
+        bit-for-bit) and the pipelined segments."""
+        k = kr * r
+        n = flat.shape[0]
+        pad = (-n) % s
+        chunks = jnp.pad(flat, (0, pad)).reshape(s, -1)
+
         with trace_stage(f"{STAGE_EXCHANGE}/hier_stage1_compress"):
             payloads, ctx_arrays, treedef, static = _shard_compress(
                 compressor, chunks, rng, "HierarchicalAllreduce",
                 shared=shared)
-
-        # Error feedback covers the stage-1 shard encode exactly; the
-        # intra-slice hop requants and the one slice-boundary re-encode
-        # are downstream of it (same contract as Ring/TwoShot).
-        view_ctx = (treedef, static, ctx_arrays, n, shape, dtype, None)
-        mem_state = memory.update(compensated, payloads, view_ctx,
-                                  _ChunkedView(compressor), mem_state)
 
         i = lax.axis_index(self.axis_name)
         local = i % s                            # position within the slice
@@ -1383,7 +1599,11 @@ class HierarchicalAllreduce(Communicator):
                     recv = tuple(lax.ppermute(t, self.axis_name, perm_intra)
                                  for t in send)
                     own = take_payload(payloads, (local - 2 - hop) % s)
-                    send = tuple(r + o for r, o in zip(recv, own))
+                    # Codec payload-space add: elementwise for plain wire
+                    # words (the committed spelling bit-for-bit), a fused
+                    # packed-field accumulate for sub-byte homomorphic
+                    # payloads (see RingAllreduce).
+                    send = compressor.payload_add(recv, own)
             partial = send       # wire-format slice partial of shard `local`
             # Phase 2: the payload algebra makes the cross-slice exchange
             # an exact payload-space sum of the K slice partials — no
@@ -1394,12 +1614,13 @@ class HierarchicalAllreduce(Communicator):
                 stacked = gather_groups(
                     partial, dcn_groups,
                     f"{STAGE_EXCHANGE}/hier_cross_slice")
-                # dtype pinned to the wire dtype: numpy promotion would
-                # silently widen integer level sums to int32 here, but the
-                # accumulator width is the codec's declared contract
-                # (payload_sum_max_world bounds W so THIS dtype is enough).
-                owned = tuple(jnp.sum(t, axis=0, dtype=t.dtype)
-                              for t in stacked)
+                # payload_sum pins the accumulation to the wire dtype:
+                # numpy promotion would silently widen integer level sums
+                # to int32 here, but the accumulator width is the codec's
+                # declared contract (payload_sum_max_world bounds W so
+                # THIS dtype is enough); packed homomorphic payloads
+                # reduce in field space through the fused accumulate.
+                owned = compressor.payload_sum(stacked)
                 if r > 1:
                     # Level 3: the region partials cross WAN still in
                     # payload space — the exact/homomorphic algebra makes
@@ -1408,8 +1629,7 @@ class HierarchicalAllreduce(Communicator):
                     stacked_w = gather_groups(
                         owned, wan_groups,
                         f"{STAGE_EXCHANGE}/hier_cross_region")
-                    owned = tuple(jnp.sum(t, axis=0, dtype=t.dtype)
-                                  for t in stacked_w)
+                    owned = compressor.payload_sum(stacked_w)
             else:
                 owned = partial
             if compressor.average and not homo:
@@ -1476,9 +1696,11 @@ class HierarchicalAllreduce(Communicator):
                 stacked = gather_groups(
                     tuple(payload_b), dcn_groups,
                     f"{STAGE_EXCHANGE}/hier_cross_slice")
-                decoded = jax.vmap(
-                    lambda p: compressor.decompress(p, ctx_b))(stacked)
-                agg = compressor.aggregate(decoded)
+                # Fused decode+aggregate of the Kr gathered slice partials
+                # for wire-path codecs; the staged vmap-decompress +
+                # aggregate spelling otherwise (see _gathered_aggregate).
+                agg = _gathered_aggregate(compressor, compressor, stacked,
+                                          ctx_b, kr)
                 if r > 1:
                     # The ONE region-boundary requant, one level up: every
                     # rank of a dcn group now holds the identical region
@@ -1505,9 +1727,10 @@ class HierarchicalAllreduce(Communicator):
                     stacked_w = gather_groups(
                         tuple(payload_w), wan_groups,
                         f"{STAGE_EXCHANGE}/hier_cross_region")
-                    decoded_w = jax.vmap(
-                        lambda p: wan_codec.decompress(p, ctx_w))(stacked_w)
-                    agg = compressor.aggregate(decoded_w)
+                    # Base codec supplies the aggregation semantics even
+                    # when the aggressive WAN codec did the encode.
+                    agg = _gathered_aggregate(compressor, wan_codec,
+                                              stacked_w, ctx_w, r)
             else:
                 # Singleton stack: sum codecs pass through, vote codecs
                 # re-sign the final tally — same as the flat ring.
@@ -1524,8 +1747,7 @@ class HierarchicalAllreduce(Communicator):
             with trace_stage(STAGE_DECOMPRESS):
                 out = jax.vmap(
                     lambda p: compressor.decompress(p, ctx2))(gathered)
-        out = out.reshape(-1)[:n].reshape(shape).astype(dtype)
-        return out, mem_state, comp_state
+        return out.reshape(-1)[:n], payloads, ctx_arrays, treedef, static
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:

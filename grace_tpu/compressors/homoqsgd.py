@@ -31,6 +31,20 @@ to ``32767 // quantum_num`` (4681 at the 4-bit ``quantum_num=7``). The
 win over fp16 is not bytes, it is the *quality* story: hop-count-
 independent compression error at ring/hier's O(k) wire cost, where plain
 qsgd pays W−2 intermediate requants and topk re-selects every hop.
+
+**Packed wire mode** (``accum_bits`` ∈ {2, 3, 4}, ROADMAP item 2): the
+levels ship as sub-byte two's-complement fields through the
+:mod:`grace_tpu.ops.packing` reference packers — 8/5.3/4× less wire than
+int16 — and the payload-space accumulate becomes unpack → integer add →
+repack (staged jnp, or ONE fused Pallas kernel,
+:func:`grace_tpu.ops.pallas_wire.packed_int_accumulate`, under the shared
+``"wire"`` selection rule; both integer-exact, so byte-identical). The
+field IS the accumulator: ``payload_sum_max_world`` tightens to
+``(2^(accum_bits-1) - 1) // quantum_num`` — at 2 bits with
+``quantum_num=1`` that bound is W=1, making the accumulator bound (not
+the wire width) the binding constraint, which the tuner's numeric gate
+and flow pass 6 reject statically and the communicators' runtime gate
+rejects from the SAME constant.
 """
 
 from __future__ import annotations
@@ -42,6 +56,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from grace_tpu.core import Compressor, Ctx, Payload, State
+from grace_tpu.ops.packing import (pack_2bit, pack_3bit, pack_4bit,
+                                   unpack_2bit, unpack_3bit, unpack_4bit)
+
+_PACKERS = {2: (pack_2bit, unpack_2bit), 3: (pack_3bit, unpack_3bit),
+            4: (pack_4bit, unpack_4bit)}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,8 +75,32 @@ class HomoQSGDCompressor(Compressor):
 
     quantum_num: int = 7          # 4-bit levels, the qsgd4 wire family
     accum_dtype: str = "int16"    # payload/accumulator width (int8/16/32)
+    # Packed sub-byte wire mode: None ships accum_dtype levels (the
+    # original wire format, untouched); 2/3/4 packs the levels into
+    # two's-complement fields of that width — the field is then BOTH the
+    # wire word and the hop accumulator, so payload_sum_max_world derives
+    # from it instead of accum_dtype.
+    accum_bits: int | None = None
+    # Fused payload-accumulate kernel selection for the packed mode
+    # (grace_tpu.ops.pallas_mode, family "wire"); integer-exact either
+    # way, so this knob can only move WHERE the add runs.
+    use_pallas: bool | str = "auto"
 
     def __post_init__(self):
+        if not (self.use_pallas == "auto" or self.use_pallas is True
+                or self.use_pallas is False):
+            raise ValueError(f"use_pallas must be True, False or 'auto'; "
+                             f"got {self.use_pallas!r}")
+        if self.accum_bits is not None:
+            if self.accum_bits not in (2, 3, 4):
+                raise ValueError(f"accum_bits must be 2, 3, 4 or None; "
+                                 f"got {self.accum_bits}")
+            ceil = (1 << (self.accum_bits - 1)) - 1
+            if self.quantum_num > ceil:
+                raise ValueError(
+                    f"quantum_num={self.quantum_num} does not fit ONE "
+                    f"rank's level in a {self.accum_bits}-bit two's-"
+                    f"complement field (magnitude <= {ceil})")
         dt = jnp.dtype(self.accum_dtype)
         if not jnp.issubdtype(dt, jnp.signedinteger):
             raise ValueError(f"accum_dtype must be a signed integer dtype "
@@ -75,12 +118,19 @@ class HomoQSGDCompressor(Compressor):
     def payload_sum_max_world(self) -> int:
         """Largest world whose payload-space sum stays exact: each rank
         contributes a level in ``[-quantum_num, quantum_num]``, so a W-rank
-        sum lives in ``[-W·q, W·q]`` and is exact iff ``W·q <=
-        iinfo(accum_dtype).max``. int16 @ q=7 → 4681; int8 @ q=7 → 18 (a
-        W=32 mesh fires the static numeric-safety finding AND the runtime
-        gate from this same function)."""
-        return int(jnp.iinfo(jnp.dtype(self.accum_dtype)).max) \
-            // self.quantum_num
+        sum lives in ``[-W·q, W·q]`` and is exact iff ``W·q`` fits the
+        accumulator's positive range. In packed mode the sub-byte field IS
+        the accumulator, so the ceiling is ``2^(accum_bits-1) - 1`` —
+        4-bit @ q=1 → 7, and 2-bit @ q=1 → 1, the config the static pass,
+        the tuner's numeric gate and the runtime gate all reject from this
+        same function. Unpacked: ``iinfo(accum_dtype).max`` (int16 @ q=7 →
+        4681; int8 @ q=7 → 18 — a W=32 mesh fires the static finding AND
+        the runtime gate)."""
+        if self.accum_bits is not None:
+            ceil = (1 << (self.accum_bits - 1)) - 1
+        else:
+            ceil = int(jnp.iinfo(jnp.dtype(self.accum_dtype)).max)
+        return ceil // self.quantum_num
 
     # -- negotiation ---------------------------------------------------------
     def negotiate(self, x: jax.Array, axis_name: str,
@@ -121,13 +171,73 @@ class HomoQSGDCompressor(Compressor):
         # ±q by construction; the clip only guards the local-scale
         # fallback's float edge cases.
         signed = jnp.clip(level * jnp.sign(flat.astype(jnp.float32)), -q, q)
+        if self.accum_bits is not None:
+            w = self.accum_bits
+            codes = jnp.where(signed < 0, signed + float(1 << w),
+                              signed).astype(jnp.uint8)
+            return (_PACKERS[w][0](codes),), (shape, x.dtype, scale), state
         levels = signed.astype(jnp.dtype(self.accum_dtype))
         return (levels,), (shape, x.dtype, scale), state
+
+    def _unpack_levels(self, packed: jax.Array, n_slots: int) -> jax.Array:
+        w = self.accum_bits
+        codes = _PACKERS[w][1](packed, n_slots).astype(jnp.int32)
+        return jnp.where(codes >= (1 << (w - 1)), codes - (1 << w), codes)
+
+    def _pack_levels(self, levels: jax.Array) -> jax.Array:
+        w = self.accum_bits
+        codes = jnp.mod(levels, 1 << w).astype(jnp.uint8)
+        return _PACKERS[w][0](codes)
+
+    @staticmethod
+    def _slots(nbytes: int, width: int) -> int:
+        # Every code slot the packed bytes can hold (>= numel; the tail
+        # slots are zero by the packers' zero padding, so accumulating
+        # over slots instead of elements is exact and length-preserving).
+        return nbytes * 8 // width
+
+    def _packed_accumulate(self, stacked: jax.Array) -> jax.Array:
+        """(K, nbytes) packed payloads -> the packed integer level sum:
+        unpack → add → repack, as ONE fused Pallas kernel when the shared
+        "wire" selection rule enables it, staged jnp otherwise. Integer-
+        exact both ways (byte-identical outputs) whenever the true sums
+        fit the field — the payload_sum_max_world gate's invariant."""
+        from grace_tpu.ops import pallas_mode
+        enabled, interpret = pallas_mode(self.use_pallas, kernel="wire")
+        n_slots = self._slots(int(stacked.shape[1]), self.accum_bits)
+        if enabled:
+            from grace_tpu.ops.pallas_wire import packed_int_accumulate
+            return packed_int_accumulate(stacked, n_slots, self.accum_bits,
+                                         interpret=interpret)
+        levels = jax.vmap(lambda p: self._unpack_levels(p, n_slots))(stacked)
+        return self._pack_levels(jnp.sum(levels, axis=0))
+
+    def wire_fused(self) -> bool:
+        """Live wire-kernel gate (core.Compressor.wire_fused): True when
+        the packed accumulate would run as the fused Pallas kernel."""
+        if self.accum_bits is None:
+            return False
+        from grace_tpu.ops import pallas_mode
+        return pallas_mode(self.use_pallas, kernel="wire")[0]
+
+    def payload_add(self, a: Payload, b: Payload) -> Payload:
+        if self.accum_bits is None:
+            return super().payload_add(a, b)
+        return (self._packed_accumulate(jnp.stack([a[0], b[0]])),)
+
+    def payload_sum(self, stacked: Payload) -> Payload:
+        if self.accum_bits is None:
+            return super().payload_sum(stacked)
+        return (self._packed_accumulate(stacked[0]),)
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
         """Linear in the (possibly hop-summed) levels: ``scale/q · levels``
         — decode-of-the-sum IS the sum-of-decodes, exactly."""
         (levels,) = payload
         shape, dtype, scale = ctx
+        if self.accum_bits is not None:
+            import numpy as np
+            numel = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            levels = self._unpack_levels(levels, numel)
         out = scale / self.quantum_num * levels.astype(jnp.float32)
         return out.reshape(shape).astype(dtype)

@@ -9,14 +9,22 @@ instead (exact, same wire width). The torch copy's leftover debug prints
 (torch/compressor/qsgd.py:14-15,33-34) are, of course, not replicated.
 
 Sub-byte wire format (grace-tpu extension, no reference analog): for
-``quantum_num <= 7`` the signed levels fit a 4-bit two's-complement
-nibble, so the payload ships packed 2 codes/byte — 2× less wire than int8
-— via :func:`grace_tpu.ops.packing.pack_4bit` (staged path) or the fused
-Pallas quantize-and-pack kernel
+``quantum_num <= 7`` the signed levels fit a two's-complement sub-byte
+field, so the payload ships packed — the field width follows the level
+range (:attr:`QSGDCompressor.pack_width`): 2-bit at ``quantum_num <= 1``
+(4 codes/byte), 3-bit at ``<= 3`` (an LSB-first bitstream, 8 codes per
+3 bytes), 4-bit at ``<= 7`` (2 codes/byte) — via the
+:mod:`grace_tpu.ops.packing` reference packers (staged path) or the
+fused Pallas quantize-and-pack kernel
 (:func:`grace_tpu.ops.pallas_quant.quantize_pack_stochastic`), which
 emits the packed bytes directly from VMEM with no full-width intermediate
 in HBM. Both paths produce the identical byte layout (the pack_widths
-contract, bit-identity pinned in tests/test_pallas_quant.py).
+contract, bit-identity pinned in tests/test_pallas_quant.py). The decode
+side of the wire path is fused too: :meth:`decode_accumulate` runs the
+ring-hop / boundary decode→accumulate as ONE Pallas kernel
+(:mod:`grace_tpu.ops.pallas_wire`) when the shared selection rule
+(:func:`grace_tpu.ops.pallas_mode`, family ``"wire"``) enables it,
+bit-identical to the staged sequential decompress-and-add.
 """
 
 from __future__ import annotations
@@ -27,7 +35,12 @@ import jax
 import jax.numpy as jnp
 
 from grace_tpu.core import Compressor, Ctx, Payload, State
-from grace_tpu.ops.packing import pack_4bit, unpack_4bit
+from grace_tpu.ops.packing import (pack_2bit, pack_3bit, pack_4bit,
+                                   unpack_2bit, unpack_3bit, unpack_4bit)
+
+# Staged reference packers per two's-complement field width.
+_PACKERS = {2: (pack_2bit, unpack_2bit), 3: (pack_3bit, unpack_3bit),
+            4: (pack_4bit, unpack_4bit)}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,28 +77,43 @@ class QSGDCompressor(Compressor):
                              f"got {self.use_pallas!r}")
 
     def _pallas_mode(self):
-        from grace_tpu.ops import pallas_disabled
-        if pallas_disabled(explicit=self.use_pallas is True, kernel="quant"):
-            return False, False
-        if self.use_pallas == "auto":
-            # Kernel on real TPU, staged elsewhere: the round-5 on-chip A/B
-            # (BENCH_ALL_TPU_LAST.json 2026-08-01, same session) measured
-            # the fused quant kernel at 2111 img/s vs 1483 staged (0.824 vs
-            # 0.580 of dense) — unlike Top-K, where the staged path wins,
-            # QSGD's per-element stochastic rounding gains 42% from the
-            # single-pass kernel with in-core PRNG.
-            return jax.default_backend() == "tpu", False
-        if self.use_pallas is True:
-            on_tpu = jax.default_backend() == "tpu"
-            return True, not on_tpu
-        return False, False
+        # The ONE shared selection rule (grace_tpu.ops.pallas_mode): under
+        # 'auto' the kernel runs on real TPU and the staged path elsewhere
+        # — the round-5 on-chip A/B (BENCH_ALL_TPU_LAST.json 2026-08-01)
+        # measured the fused quant kernel at 2111 img/s vs 1483 staged
+        # (0.824 vs 0.580 of dense): unlike Top-K, where the staged path
+        # wins, QSGD's per-element stochastic rounding gains 42% from the
+        # single-pass kernel with in-core PRNG.
+        from grace_tpu.ops import pallas_mode
+        return pallas_mode(self.use_pallas, kernel="quant")
+
+    def _wire_mode(self):
+        # Decode-side kernels are their own family ("wire"): a Mosaic
+        # failure in one side must not force the other onto its staged
+        # path (the PR-10 lesson that split _QUANT from _TOPK).
+        from grace_tpu.ops import pallas_mode
+        return pallas_mode(self.use_pallas, kernel="wire")
 
     @property
     def packed_wire(self) -> bool:
-        """True iff the payload ships 4-bit packed nibbles (2 codes/byte):
-        the sub-byte wire format engages when the level range (±quantum_num
-        after the overshoot clamp) fits a two's-complement nibble."""
+        """True iff the payload ships sub-byte packed codes: the packed
+        wire format engages when the level range (±quantum_num after the
+        overshoot clamp) fits a two's-complement nibble or narrower."""
         return self.quantum_num <= 7
+
+    @property
+    def pack_width(self) -> int:
+        """Two's-complement field width of the packed wire format: the
+        narrowest of {2, 3, 4} whose magnitude ceiling ``2^(w-1) - 1``
+        holds ``quantum_num`` (1 → 2-bit, 3 → 3-bit, 7 → 4-bit). Only
+        meaningful when :attr:`packed_wire`; declared in
+        ``ops.packing.pack_widths()`` so flow pass 6's sub-byte audit
+        covers every width this property can select."""
+        if self.quantum_num <= 1:
+            return 2
+        if self.quantum_num <= 3:
+            return 3
+        return 4
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
@@ -100,7 +128,8 @@ class QSGDCompressor(Compressor):
                 from grace_tpu.ops.pallas_quant import \
                     quantize_pack_stochastic
                 packed = quantize_pack_stochastic(
-                    flat, norm, seed, self.quantum_num, interpret=interpret)
+                    flat, norm, seed, self.quantum_num,
+                    width=self.pack_width, interpret=interpret)
                 return (packed, norm), (shape, x.dtype), state
             from grace_tpu.ops.pallas_quant import quantize_stochastic
             signed = quantize_stochastic(flat, norm, seed, self.quantum_num,
@@ -115,14 +144,15 @@ class QSGDCompressor(Compressor):
         new_level = previous_level + is_next
         signed = new_level * jnp.sign(flat)
         if self.packed_wire:
-            # Same clamp + nibble fold as the fused kernel, then the
-            # reference packer — staged and kernel paths share ONE byte
-            # layout (they differ only in the PRNG stream).
+            # Same clamp + two's-complement fold as the fused kernel, then
+            # the reference packer — staged and kernel paths share ONE
+            # byte layout (they differ only in the PRNG stream).
+            w = self.pack_width
             q = float(self.quantum_num)
             clamped = jnp.clip(signed.astype(jnp.float32), -q, q)
-            codes = jnp.where(clamped < 0, clamped + 16.0,
+            codes = jnp.where(clamped < 0, clamped + float(1 << w),
                               clamped).astype(jnp.uint8)
-            return (pack_4bit(codes), norm), (shape, x.dtype), state
+            return (_PACKERS[w][0](codes), norm), (shape, x.dtype), state
         return (signed.astype(dtype), norm), (shape, x.dtype), state
 
     def decompress(self, payload: Payload, ctx: Ctx) -> jax.Array:
@@ -130,8 +160,46 @@ class QSGDCompressor(Compressor):
         shape, dtype = ctx
         if self.packed_wire:
             import numpy as np
+            w = self.pack_width
             numel = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            codes = unpack_4bit(levels, numel).astype(jnp.int8)
-            levels = jnp.where(codes >= 8, codes - 16, codes)
+            codes = _PACKERS[w][1](levels, numel).astype(jnp.int8)
+            levels = jnp.where(codes >= (1 << (w - 1)), codes - (1 << w),
+                               codes)
         out = norm / self.quantum_num * levels.astype(dtype)
+        return out.reshape(shape)
+
+    def wire_fused(self) -> bool:
+        """Live wire-kernel gate (core.Compressor.wire_fused): True only
+        when the shared selection rule enables the "wire" family AND the
+        payload ships packed — exactly the condition under which
+        :meth:`decode_accumulate` takes its fused branch."""
+        return self._wire_mode()[0] and self.packed_wire
+
+    def decode_accumulate(self, payloads, ctxs):
+        """The fused hop decode: K packed payloads -> one f32 partial in
+        ONE Pallas kernel (grace_tpu.ops.pallas_wire.decode_accumulate),
+        bit-identical to the staged sequential ``decompress +
+        decompress`` the base hook runs (same unpack layout, same
+        sign-extension, same per-payload ``norm/quantum_num`` scalar
+        division, same accumulation order) — so 'auto' gating can only
+        ever change WHERE the hop runs. Falls back to the staged spelling
+        whenever the wire-kernel family is disabled, the payload is not
+        packed, or the decode dtype is not f32."""
+        enabled, interpret = self._wire_mode()
+        shape, dtype = ctxs[0]
+        if (not enabled or not self.packed_wire
+                or jnp.dtype(dtype) != jnp.float32
+                or any(c[:2] != (shape, dtype) for c in ctxs)):
+            return super().decode_accumulate(payloads, ctxs)
+        import numpy as np
+
+        from grace_tpu.ops.pallas_wire import decode_accumulate as _fused
+        numel = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        stacked = jnp.stack([p[0] for p in payloads])
+        # The staged decompress computes ``norm / quantum_num * level``:
+        # the identical scalar division here feeds the kernel, so even
+        # the scale bits match the staged path.
+        scales = jnp.stack([p[1] / self.quantum_num for p in payloads])
+        out = _fused(stacked, scales, numel, self.pack_width,
+                     interpret=interpret)
         return out.reshape(shape)

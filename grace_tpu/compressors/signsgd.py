@@ -58,16 +58,9 @@ class SignSGDCompressor(Compressor):
                              f"got {self.use_pallas!r}")
 
     def _pallas_mode(self):
-        import jax as _jax
-
-        from grace_tpu.ops import pallas_disabled
-        if pallas_disabled(explicit=self.use_pallas is True, kernel="quant"):
-            return False, False
-        if self.use_pallas == "auto":
-            return _jax.default_backend() == "tpu", False
-        if self.use_pallas is True:
-            return True, _jax.default_backend() != "tpu"
-        return False, False
+        # The ONE shared selection rule — see grace_tpu.ops.pallas_mode.
+        from grace_tpu.ops import pallas_mode
+        return pallas_mode(self.use_pallas, kernel="quant")
 
     def compress(self, x: jax.Array, state: State, rng: jax.Array
                  ) -> tuple[Payload, Ctx, State]:
@@ -91,6 +84,33 @@ class SignSGDCompressor(Compressor):
         # Majority vote: reference signsgd.py:25-30.
         summed = jnp.sum(stacked, axis=0)
         return (summed >= 0).astype(stacked.dtype) * 2 - 1
+
+    def wire_fused(self) -> bool:
+        """Live wire-kernel gate (core.Compressor.wire_fused) — the
+        condition under which :meth:`decode_accumulate` takes its fused
+        branch, consulted by the communicators' gather boundaries."""
+        from grace_tpu.ops import pallas_mode
+        return pallas_mode(self.use_pallas, kernel="wire")[0]
+
+    def decode_accumulate(self, payloads, ctxs):
+        """The fused sign-hop decode: unpack K packed masks, map to ±1
+        and sum in ONE Pallas kernel (pallas_wire.decode_accumulate,
+        sign=True) — sign extraction is deterministic, so the kernel is
+        bit-identical to the staged ``decompress + decompress`` (small
+        integers, exact in f32) everywhere, not just in distribution.
+        Staged fallback under the shared wire-family selection rule."""
+        from grace_tpu.ops import pallas_mode
+        enabled, interpret = pallas_mode(self.use_pallas, kernel="wire")
+        numel, shape, dtype = ctxs[0]
+        if (not enabled or jnp.dtype(dtype) != jnp.float32
+                or any(c != (numel, shape, dtype) for c in ctxs)):
+            return super().decode_accumulate(payloads, ctxs)
+        from grace_tpu.ops.pallas_wire import decode_accumulate as _fused
+        stacked = jnp.stack([p[0] for p in payloads])
+        scales = jnp.ones((stacked.shape[0],), jnp.float32)
+        out = _fused(stacked, scales, numel, 1, sign=True,
+                     interpret=interpret)
+        return out.astype(dtype).reshape(shape)
 
 
 @dataclasses.dataclass(frozen=True)

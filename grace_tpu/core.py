@@ -31,7 +31,7 @@ decompression is then value-exact without a length field.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -526,6 +526,54 @@ class Compressor:
         """
         return jnp.sum(stacked, axis=0)
 
+    # -- the kernel-resident wire path (ROADMAP item 2) ---------------------
+    # The communicators' hop/boundary arithmetic is routed through these
+    # three hooks so a codec can swap in fused Pallas kernels
+    # (grace_tpu.ops.pallas_wire) without the schedules knowing. The
+    # defaults reproduce the staged spellings the schedules ran before the
+    # hooks existed — BIT-EXACTLY, which is what lets an override claim
+    # "bit-identical to the staged path" against a stable reference.
+
+    def decode_accumulate(self, payloads: Sequence[Payload],
+                          ctxs: Sequence[Ctx]) -> jax.Array:
+        """Decode K payloads and sum them into one dense partial, in
+        sequence order — the ring hop's ``decompress(recv) +
+        decompress(own)`` and the requant boundary's decode-side sum.
+        Codecs with fused decode→accumulate kernels override this; the
+        default is the staged left-to-right spelling."""
+        out = self.decompress(payloads[0], ctxs[0])
+        for payload, ctx in zip(payloads[1:], ctxs[1:]):
+            out = out + self.decompress(payload, ctx)
+        return out
+
+    def payload_add(self, a: Payload, b: Payload) -> Payload:
+        """Payload-space ``a + b`` for summable payloads (the exact-path
+        ring hop). Default: element-wise tuple add — only meaningful when
+        :attr:`summable_payload`; packed shared-scale codecs override
+        with unpack→add→repack (optionally fused)."""
+        return tuple(r + o for r, o in zip(a, b))
+
+    def payload_sum(self, stacked: Payload) -> Payload:
+        """Payload-space sum over a stacked leading world axis (the
+        gather-boundary accumulate of the homomorphic paths). Default:
+        dtype-pinned ``jnp.sum`` per leaf — the accumulator IS the
+        payload dtype, so overflow is governed by
+        :meth:`payload_sum_max_world`, never silently widened away."""
+        return tuple(jnp.sum(t, axis=0, dtype=t.dtype) for t in stacked)
+
+    def wire_fused(self) -> bool:
+        """True when this codec's fused wire-path kernels would actually
+        run under the current selection rule (``use_pallas`` knob, backend
+        and the GRACE_DISABLE_PALLAS[_WIRE] escape hatches — ONE rule,
+        :func:`grace_tpu.ops.pallas_mode`). The communicators consult this
+        before swapping a gather boundary's staged vmap-decompress +
+        aggregate spelling for the fused K-way ``decode_accumulate`` pass:
+        the two associate float adds differently, so the swap must never
+        happen behind a disabled kernel's back — staged runs must stay
+        bit-identical to the committed schedules. Default False (no wire
+        kernels)."""
+        return False
+
 
 @dataclasses.dataclass(frozen=True)
 class Memory:
@@ -658,6 +706,17 @@ class Communicator:
         """
         return self.recv_link_bytes(payload_nbytes, n_elems, world,
                                     vote=vote).total
+
+    def wire_overlap_fraction(self) -> float:
+        """Fraction of this communicator's wire time the schedule itself
+        can hide behind hop compute — the ``wire_pipeline`` discount the
+        tuner's cost model and the bench projections apply. 0.0 for every
+        serial schedule (the NO-OVERLAP upper bound stands unchanged);
+        the pipelined ring/hier schedules override with their
+        double-buffer bound, and flow pass 5's chain count is the static
+        referee that the traced graph actually exposes the claimed
+        independent chains."""
+        return 0.0
 
     def exchange(self, payload: Payload, ctx: Ctx, compressor: Compressor
                  ) -> jax.Array:

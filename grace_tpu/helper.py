@@ -149,7 +149,9 @@ def _build_compressor(params: Dict[str, Any], axis: str) -> Compressor:
         # the integer payload for exact W-rank sums.
         return C.HomoQSGDCompressor(
             quantum_num=params.get("quantum_num", 7),
-            accum_dtype=params.get("accum_dtype", "int16"))
+            accum_dtype=params.get("accum_dtype", "int16"),
+            accum_bits=params.get("accum_bits"),
+            use_pallas=params.get("use_pallas", "auto"))
     if name == "countsketch":
         return C.CountSketchCompressor(
             compress_ratio=params.get("compress_ratio", 0.25),
@@ -220,7 +222,12 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
             axis_name=axis,
             stage2_feedback=bool(params.get("stage2_feedback", False)))
     if name in ("ring", "ring_allreduce"):
-        return comm.RingAllreduce(axis_name=axis)
+        # pipeline: double-buffered wire schedule — P > 1 splits the flat
+        # buffer into P segments whose ring schedules trace as independent
+        # chains (flow pass 5's pipelined-ring referee), letting hop k of
+        # segment p overlap hop k+1 of segment p-1 on real links.
+        return comm.RingAllreduce(axis_name=axis,
+                                  pipeline=int(params.get("pipeline", 1)))
     if name in ("rscatter", "reduce_scatter", "rscatter_allreduce"):
         # Compressed reduce-scatter + all-gather over the dp axis: the
         # sharded-model (FSDP) exchange — one all_to_all instead of the
@@ -240,7 +247,8 @@ def _build_communicator(params: Dict[str, Any], axis: str) -> Communicator:
         return comm.HierarchicalAllreduce(
             axis_name=axis, slice_size=params.get("slice_size"),
             region_size=params.get("region_size"),
-            wan_compressor=wan)
+            wan_compressor=wan,
+            pipeline=int(params.get("pipeline", 1)))
     if name in ("sign_allreduce", "signallreduce"):
         return comm.SignAllreduce(
             axis_name=axis,

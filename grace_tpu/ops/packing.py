@@ -32,14 +32,16 @@ def pack_widths():
     automatically under audit the moment it joins the tuple.
 
     The 1-bit entry is signsgd/signum's sign mask, the 2-bit entry
-    terngrad-style codes, the 4-bit entry QSGD's sub-byte wire format
+    terngrad-style codes (and QSGD/homoqsgd at ``quantum_num <= 1``), the
+    3-bit entry the LSB-first bitstream QSGD/homoqsgd ship at
+    ``quantum_num <= 3``, the 4-bit entry QSGD's sub-byte wire format
     (``quantum_num <= 7``: two's-complement nibbles, low nibble first) —
     the widths the fused Pallas compress-and-pack kernels
     (:mod:`grace_tpu.ops.pallas_quant`) emit directly, so the kernels'
     wire layout is pinned to these reference packers by the bit-identity
     tests AND re-audited here on every lint run."""
     return ((1, pack_bits, unpack_bits), (2, pack_2bit, unpack_2bit),
-            (4, pack_4bit, unpack_4bit))
+            (3, pack_3bit, unpack_3bit), (4, pack_4bit, unpack_4bit))
 
 
 def pack_bits(bits: jax.Array) -> jax.Array:
@@ -75,6 +77,35 @@ def unpack_2bit(packed: jax.Array, n: int) -> jax.Array:
     shifts = jnp.arange(0, 8, 2, dtype=jnp.uint8)
     codes = (packed[:, None] >> shifts) & jnp.uint8(3)
     return codes.reshape(-1)[:n]
+
+
+def pack_3bit(codes: jax.Array) -> jax.Array:
+    """Pack a 1-D array of 3-bit codes (values 0..7) into uint8 —
+    ``ceil(3n/8)`` bytes, LSB-first bitstream: bit ``b`` of code ``l``
+    lands at global bit ``3l + b``, and bit ``k`` of byte ``j`` is global
+    bit ``8j + k``. Unlike the power-of-two widths, 3-bit codes straddle
+    byte boundaries, so the layout is defined on the bitstream (not on
+    shifted lanes within one byte) — which is exactly what keeps the
+    declared ``ceil(n*bits/8)`` byte-count contract exact at every
+    length."""
+    n = codes.shape[0]
+    nbytes = _ceil_div(3 * n, 8)
+    shifts = jnp.arange(3, dtype=jnp.uint8)
+    bits = ((codes.astype(jnp.uint8)[:, None] >> shifts)
+            & jnp.uint8(1)).reshape(-1)
+    padded = jnp.zeros((nbytes * 8,), jnp.uint8).at[:3 * n].set(bits)
+    lanes = padded.reshape(nbytes, 8)
+    byte_shifts = jnp.arange(8, dtype=jnp.uint8)
+    return jnp.sum(lanes << byte_shifts, axis=1, dtype=jnp.uint8)
+
+
+def unpack_3bit(packed: jax.Array, n: int) -> jax.Array:
+    """Inverse of :func:`pack_3bit`; returns uint8 codes of length ``n``."""
+    byte_shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((packed[:, None] >> byte_shifts) & jnp.uint8(1)).reshape(-1)
+    trip = bits[:3 * n].reshape(n, 3)
+    shifts = jnp.arange(3, dtype=jnp.uint8)
+    return jnp.sum(trip << shifts, axis=1, dtype=jnp.uint8)
 
 
 def pack_4bit(codes: jax.Array) -> jax.Array:

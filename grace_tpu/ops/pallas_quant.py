@@ -164,6 +164,26 @@ def _pack_matrix_np(width: int, in_lanes: int):
     return w
 
 
+@functools.lru_cache(maxsize=4)
+def _pack_matrix3_np(in_lanes: int):
+    """3-bit bit-plane pack matrix: row ``b·L + l`` (bit ``b`` of code
+    ``l``) routes to output byte ``(3l+b)//8`` with weight ``2^((3l+b)%8)``
+    — :func:`grace_tpu.ops.packing.pack_3bit`'s LSB-first bitstream. 3
+    does not divide 8, so codes straddle byte boundaries and the per-code
+    shift trick of :func:`_pack_matrix_np` cannot apply; decomposing each
+    code into its three bit planes first makes the pack three dots (one
+    per plane) against row-slices of this one constant — every output
+    byte still sums 8 disjoint weighted bits, ≤ 255, exact in f32."""
+    import numpy as np
+
+    w = np.zeros((3 * in_lanes, 3 * in_lanes // 8), np.float32)
+    for b in range(3):
+        for lane in range(in_lanes):
+            gb = 3 * lane + b
+            w[b * in_lanes + lane, gb // 8] = float(1 << (gb % 8))
+    return w
+
+
 def _pack_matrix(width: int, in_lanes: int) -> jax.Array:
     """The constant pack matrix: ``W[l, l // (8//width)] = 2^(width·(l mod
     8//width))``, zero elsewhere. ``codes @ W`` sums each group of
@@ -185,73 +205,106 @@ def _pack_lanes(codes, packw_ref):
     return packed.astype(jnp.int32).astype(jnp.uint8)
 
 
-def _make_quantize_pack_kernel(hw_prng: bool):
+def _pack_lanes3(codes, packw_ref):
+    """Pack f32 integer codes (rows, L) -> (rows, 3L/8) uint8 in
+    :func:`grace_tpu.ops.packing.pack_3bit`'s bitstream layout: three
+    bit-plane dots against row-slices of the :func:`_pack_matrix3_np`
+    constant, summed (disjoint output bits, so the sum is the OR)."""
+    lanes = codes.shape[-1]
+    w = packw_ref[:]
+    acc = None
+    for b in range(3):
+        plane = jnp.mod(jnp.floor(codes * (1.0 / (1 << b))), 2.0)
+        part = jax.lax.dot_general(plane, w[b * lanes:(b + 1) * lanes],
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        acc = part if acc is None else acc + part
+    return acc.astype(jnp.int32).astype(jnp.uint8)
+
+
+def _make_quantize_pack_kernel(hw_prng: bool, width: int):
     def kernel(seed_ref, scale_ref, q_ref, packw_ref, x_ref, out_ref):
         block_seed = seed_ref[0] + pl.program_id(0)
         signed = _signed_levels(x_ref[:], scale_ref[0], block_seed, hw_prng)
-        # Two's-complement nibble: clamp to ±quantum_num (stochastic
-        # overshoot past +q would not fit the nibble's +7 ceiling at q=7),
-        # then fold negatives into [8, 15]. Low nibble = first element —
-        # packing.pack_4bit's layout.
+        # Two's-complement field: clamp to ±quantum_num (stochastic
+        # overshoot past +q would not fit the field's 2^(width-1)-1
+        # ceiling), then fold negatives into the upper half of the code
+        # range. First element lands in the lowest bits — the
+        # packing.pack_{2,3,4}bit layouts.
         q = q_ref[0].astype(jnp.float32)
         signed = jnp.clip(signed, -q, q)
-        codes = signed + 16.0 * (signed < 0).astype(jnp.float32)
-        out_ref[:] = _pack_lanes(codes, packw_ref)
+        codes = signed + float(1 << width) * (signed < 0).astype(jnp.float32)
+        if width == 3:
+            out_ref[:] = _pack_lanes3(codes, packw_ref)
+        else:
+            out_ref[:] = _pack_lanes(codes, packw_ref)
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("quantum_num", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("quantum_num", "width", "interpret"))
 def quantize_pack_stochastic(flat: jax.Array, norm: jax.Array,
                              seed: jax.Array, quantum_num: int,
+                             width: int = 4,
                              interpret: bool = False) -> jax.Array:
     """Fused QSGD compress-and-pack: stochastically quantize ``flat`` (1-D
     f32) to signed levels in ``[-quantum_num, quantum_num]`` and emit the
-    packed 4-bit two's-complement wire words in one kernel — the payload
-    leaves VMEM wire-ready (``ceil(n/2)`` uint8 bytes).
+    packed ``width``-bit two's-complement wire words in one kernel — the
+    payload leaves VMEM wire-ready (``ceil(n·width/8)`` uint8 bytes).
 
-    Requires ``quantum_num <= 7`` (the 4-bit nibble's magnitude ceiling).
+    ``width`` ∈ {2, 3, 4}; requires ``quantum_num <= 2^(width-1) - 1``
+    (the two's-complement field's magnitude ceiling: 1 / 3 / 7).
     Bit-identity contract (pinned in tests/test_pallas_quant.py): equals
     :func:`quantize_stochastic` at the same seed followed by clamp →
-    nibble-fold → :func:`grace_tpu.ops.packing.pack_4bit` — same block
-    layout, same PRNG stream, same rounding expression, so fusing the pack
-    changes WHERE the bytes are produced, never WHAT they are.
+    two's-complement fold → :func:`grace_tpu.ops.packing.pack_2bit` /
+    ``pack_3bit`` / ``pack_4bit`` — same block layout, same PRNG stream,
+    same rounding expression, so fusing the pack changes WHERE the bytes
+    are produced, never WHAT they are. (3·LANES is a multiple of 8, so
+    every block row's 3-bit bitstream starts byte-aligned and the
+    per-block pack concatenates into the global bitstream exactly.)
     """
-    if quantum_num > 7:
+    if width not in (2, 3, 4):
+        raise ValueError(f"width must be 2, 3 or 4; got {width}")
+    if quantum_num > (1 << (width - 1)) - 1:
         raise ValueError(
-            f"quantize_pack_stochastic packs 4-bit two's-complement levels "
-            f"(magnitude <= 7); quantum_num={quantum_num} cannot fit — use "
+            f"quantize_pack_stochastic packs {width}-bit two's-complement "
+            f"levels (magnitude <= {(1 << (width - 1)) - 1}); "
+            f"quantum_num={quantum_num} cannot fit — use a wider pack or "
             "quantize_stochastic (int8/int16 wire) instead.")
     n = flat.size
     block = ROWS_PER_BLOCK * LANES
     n_pad = -n % block
-    # Zero padding quantizes to level 0 -> code 0, matching pack_4bit's
-    # zero-padded final byte, so a shared trailing byte is still identical.
+    # Zero padding quantizes to level 0 -> code 0, matching the reference
+    # packers' zero-padded final byte, so a shared trailing byte is still
+    # identical.
     padded = jnp.pad(flat.astype(jnp.float32), (0, n_pad))
     rows = padded.size // LANES
     x2d = padded.reshape(rows, LANES)
     scale = jnp.where(norm > 0, quantum_num / norm, 0.0).astype(jnp.float32)
+    out_lanes = LANES * width // 8
+    packw = (jnp.asarray(_pack_matrix3_np(LANES)) if width == 3
+             else _pack_matrix(width, LANES))
 
     out = pl.pallas_call(
-        _make_quantize_pack_kernel(hw_prng=not interpret),
+        _make_quantize_pack_kernel(hw_prng=not interpret, width=width),
         grid=(rows // ROWS_PER_BLOCK,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((LANES, LANES // 2), lambda i: (0, 0),
+            pl.BlockSpec(packw.shape, lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((ROWS_PER_BLOCK, LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((ROWS_PER_BLOCK, LANES // 2), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((ROWS_PER_BLOCK, out_lanes), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rows, LANES // 2), jnp.uint8),
+        out_shape=jax.ShapeDtypeStruct((rows, out_lanes), jnp.uint8),
         interpret=_interpret_mode(interpret),
     )(seed.reshape(1).astype(jnp.int32), scale.reshape(1),
-      jnp.asarray(quantum_num, jnp.int32).reshape(1),
-      _pack_matrix(4, LANES), x2d)
-    return out.reshape(-1)[: -(-n // 2)]
+      jnp.asarray(quantum_num, jnp.int32).reshape(1), packw, x2d)
+    return out.reshape(-1)[: -(-n * width // 8)]
 
 
 def _sign_pack_kernel(packw_ref, x_ref, out_ref):

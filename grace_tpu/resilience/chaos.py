@@ -187,6 +187,43 @@ class ChaosCompressor(Compressor):
     def aggregate(self, stacked: jax.Array) -> jax.Array:
         return self.inner.aggregate(stacked)
 
+    # Wire-path hooks, delegated whole (ISSUE 19): these run on RECEIVED
+    # payloads, downstream of every injection point (faults land in
+    # compress — poisoned input, bitflipped/drifted payloads — and hop
+    # re-encodes go through this wrapper's compress too), so forwarding
+    # them cannot bypass a fault the way forwarding the fused
+    # feedback/aggregate hooks would. Not forwarding them WOULD corrupt
+    # the run for real: the base payload_add/payload_sum tuple-add is
+    # garbage on a packed sub-byte payload (the packed homoqsgd
+    # accumulate must unpack→add→repack), so a chaos-wrapped packed codec
+    # must ride the inner codec's own accumulate spelling.
+    def payload_add(self, a: Payload, b: Payload) -> Payload:
+        return self.inner.payload_add(a, b)
+
+    def payload_sum(self, stacked: Payload) -> Payload:
+        return self.inner.payload_sum(stacked)
+
+    def decode_accumulate(self, payloads, ctxs):
+        return self.inner.decode_accumulate(payloads, ctxs)
+
+    def wire_fused(self) -> bool:
+        return self.inner.wire_fused()
+
+    @property
+    def packed_wire(self):
+        # Wire-format facts, delegated like payload_algebra: the tuner's
+        # variant generator and flow pass 6's sub-byte audit read these
+        # off whatever compressor the config carries.
+        return getattr(self.inner, "packed_wire", False)
+
+    @property
+    def pack_width(self):
+        return getattr(self.inner, "pack_width", None)
+
+    @property
+    def accum_bits(self):
+        return getattr(self.inner, "accum_bits", None)
+
     # -- faulted encode ------------------------------------------------------
     def compress(self, x: jax.Array, state: State, rng: jax.Array,
                  shared=None) -> tuple[Payload, Ctx, State]:

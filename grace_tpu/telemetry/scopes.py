@@ -29,7 +29,8 @@ __all__ = ["trace_stage", "match_stage", "ALL_STAGES",
            "STAGE_EXCHANGE", "STAGE_DECOMPRESS", "STAGE_MEMORY_UPDATE",
            "STAGE_FWD_BWD", "STAGE_OPTIMIZER", "STAGE_APPLY",
            "STAGE_TELEMETRY", "STAGE_DENSE_ESCAPE", "STAGE_CONSENSUS",
-           "STAGE_RING_HOP", "STAGE_WATCH", "STAGE_BUCKET", "STAGE_ADAPT"]
+           "STAGE_RING_HOP", "STAGE_WATCH", "STAGE_BUCKET", "STAGE_ADAPT",
+           "STAGE_PIPELINE"]
 
 # Canonical stage names — one vocabulary for the profiler, the report tool,
 # and the docs. Keep in sync with README "Observability".
@@ -67,6 +68,15 @@ STAGE_BUCKET = "grace/bucket"
 # controller's (tiny) cost never hides inside the telemetry scope, and
 # static findings against the ladder dispatch name this stage.
 STAGE_ADAPT = "grace/adapt"
+# Double-buffered wire pipeline (RingAllreduce/HierarchicalAllreduce with
+# pipeline=P > 1): each of the P contiguous buffer segments runs the whole
+# hop schedule under its own "grace/pipeline/<p>" span, so a device trace
+# shows segment p's ppermute hops overlapping segment p+1's stage-1 encode
+# — the per-segment attribution the static overlap pass (analysis/flow.py
+# pass 5) reads to count independent collective chains. Inner hop scopes
+# nest inside it; match_stage's rightmost rule still attributes their ops
+# to ring_hop/exchange as before.
+STAGE_PIPELINE = "grace/pipeline"
 
 # The canonical stage vocabulary, longest-prefix-matchable: the profiler,
 # tools/telemetry_report.py, and the static auditor's finding attribution
@@ -78,7 +88,7 @@ ALL_STAGES = tuple(sorted(
     (STAGE_COMPENSATE, STAGE_COMPRESS, STAGE_EXCHANGE, STAGE_DECOMPRESS,
      STAGE_MEMORY_UPDATE, STAGE_FWD_BWD, STAGE_OPTIMIZER, STAGE_APPLY,
      STAGE_TELEMETRY, STAGE_DENSE_ESCAPE, STAGE_CONSENSUS, STAGE_RING_HOP,
-     STAGE_WATCH, STAGE_BUCKET, STAGE_ADAPT),
+     STAGE_WATCH, STAGE_BUCKET, STAGE_ADAPT, STAGE_PIPELINE),
     key=len, reverse=True))
 
 

@@ -107,6 +107,19 @@ def generated_variants(spec: TuneTopology) -> List[Candidate]:
         Candidate("tune-homoqsgd4-ring",
                   {**homoq, "communicator": "ring", "fusion": "flat"},
                   source="generated"),
+        # Double-buffered ring schedule (ISSUE 19): pipeline=2 splits
+        # the fused flat buffer into two segments whose full ring
+        # schedules overlap on real links — priced with the
+        # wire_pipeline discount (cost.price_candidate reads
+        # comm.wire_overlap_fraction()), statically refereed by flow
+        # pass 5's >= P independent-chain requirement. (The 2-bit
+        # sibling needs no generated variant: the registered
+        # qsgd2-ring-packed-pipelined entry is already a registry
+        # candidate.)
+        Candidate("tune-qsgd4-ring-packed-pipelined",
+                  {**qsgd4, "communicator": "ring", "fusion": "flat",
+                   "pipeline": 2},
+                  source="generated"),
         # Self-tuning adaptive candidate (ISSUE 15): the graft-adapt
         # degradation ladder (dense escape → homoqsgd8 → homoqsgd4) over
         # the zero-requant ring. Priced at its STEADY STATE (the top
@@ -335,6 +348,17 @@ def variant_audit_entries() -> List[Tuple[str, Dict[str, Any], str]]:
           "memory": "none", "communicator": "hier", "slice_size": 4,
           "fusion": "flat"},
          "packed 4-bit wire over hier hop+boundary requant"),
+        # The double-buffered ring the tuner can now emit (ISSUE 19): the
+        # pipelined twin of the packed qsgd4 ring. Flow pass 5 must count
+        # >= 2 independent chains off the grace/pipeline scope tags — the
+        # static referee behind the wire_pipeline pricing discount. (The
+        # 2-bit sibling is the separately registered
+        # qsgd2-ring-packed-pipelined entry.)
+        ("tune-qsgd4-ring-packed-pipelined",
+         {"compressor": "qsgd", "quantum_num": 7, "use_pallas": False,
+          "memory": "none", "communicator": "ring", "fusion": "flat",
+          "pipeline": 2},
+         "double-buffered packed ring; pass-5 pipelined-chain referee"),
         # The tuner's FSDP variants (ISSUE 14): the homomorphic rscatter
         # (zero requant through all_to_all + payload-space sum) must be a
         # lint-audited schedule, not just a funnel line.

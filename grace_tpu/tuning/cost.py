@@ -34,9 +34,19 @@ Model limits (recorded in the evidence, enforced by the measured stage):
   bench history shows it is unpredictable from first principles (the
   staged qsgd path measured 42% slower than the kernel; chunk vs exact
   top-k is a 2× swing). That is what the measured shortlist is for.
-* **no overlap**: same NO-OVERLAP upper bound as ``PROJECTION_MODEL``;
-  the flow pass-5 static overlap bound rides along per candidate as the
-  honesty reference for the measured sandwich, not as a discount factor.
+* **no overlap** — with ONE declared exception: a double-buffered
+  communicator (``pipeline=P`` on Ring/Hier, ISSUE 19) advertises its own
+  ``wire_overlap_fraction()`` = ``WIRE_PIPELINE_EFFICIENCY · (P−1)/P``,
+  and the wire leg is discounted by exactly that factor
+  (``step = base + wire · (1 − overlap)``). The discount is honest
+  because it is *statically refereed*: flow pass 5 requires the traced
+  graph of a pipelined config to expose ≥ P independent
+  compress→exchange chains before the config lints clean, so a
+  communicator claiming the discount without the schedule to back it is
+  a lint error, not an optimistic projection. Everything else keeps the
+  NO-OVERLAP upper bound; the pass-5 static overlap bound still rides
+  along per candidate as the honesty reference for the measured
+  sandwich.
 """
 
 from __future__ import annotations
@@ -225,7 +235,14 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
     def wire_s(lb):
         return lb.ici / ici_bw + lb.dcn / dcn_bw + lb.wan / wan_bw
 
-    step_s = base_step_s + wire_s(link)
+    # wire_pipeline discount: the communicator's OWN declared overlap
+    # fraction (0.0 everywhere except the double-buffered ring/hier
+    # schedules, whose claim flow pass 5 referees statically — see the
+    # module docstring's model-limits note). Dense always rides the flat
+    # undiscounted psum bracket.
+    overlap = float(getattr(grace.communicator, "wire_overlap_fraction",
+                            lambda: 0.0)())
+    step_s = base_step_s + wire_s(link) * (1.0 - overlap)
     d_step_s = dense_step_s + wire_s(dense_link)
     adapt = getattr(grace, "adapt", None)
     extra: Dict[str, Any] = {}
@@ -253,6 +270,7 @@ def price_candidate(grace, model_structs, spec: TuneTopology, *,
         "dcn_bytes": int(link.dcn),
         "wan_bytes": int(link.wan),
         "wire_ms": round(wire_s(link) * 1e3, 9),
+        "wire_pipeline_overlap": round(overlap, 6),
         "dense_ici_bytes": int(dense_link.ici),
         "dense_dcn_bytes": int(dense_link.dcn),
         "dense_wan_bytes": int(dense_link.wan),

@@ -206,6 +206,28 @@ def test_bucketed_registry_config_exposes_two_chains():
     assert pass_overlap_schedulability(t) == []
 
 
+def test_pipelined_ring_registry_config_exposes_pipeline_chains():
+    """ACCEPTANCE (ISSUE 19): the registered double-buffered packed ring
+    (pipeline=2) promises — and the traced graph exposes — 2 independent
+    collective chains, one per grace/pipeline/<p> segment; the serial
+    twin exposes 1. This chain count is the static referee behind the
+    tuner's wire_pipeline discount."""
+    entry = next(e for e in AUDIT_CONFIGS
+                 if e["name"] == "qsgd2-ring-packed-pipelined")
+    grace = build_grace(entry)
+    assert grace.communicator.pipeline == 2
+    t = trace_update(grace, name=entry["name"], meta={"grace": grace})
+    assert flow._expected_chains(t) == 2
+    assert overlap_summary(t)["independent_chains"] == 2
+    assert pass_overlap_schedulability(t) == []
+    # the serial twin of the same codec exposes a single chain
+    serial = build_grace({"name": "serial",
+                          "params": {**dict(entry["params"]),
+                                     "pipeline": 1}})
+    t1 = trace_update(serial, name="serial", meta={"grace": serial})
+    assert overlap_summary(t1)["independent_chains"] == 1
+
+
 # ---------------------------------------------------------------------------
 # pass 6: numeric-range safety
 # ---------------------------------------------------------------------------
@@ -338,6 +360,35 @@ def test_broken_bit_packer_fires():
     assert findings and all("ops/packing" in f.message for f in findings)
     # the shipped packers hold their declared widths
     assert flow._packing_findings(t) == []
+
+
+@pytest.mark.parametrize("width", [2, 3, 4])
+def test_bad_packer_fires_at_every_subbyte_width(width):
+    """The pass-6 packer audit is live at the NEW widths too: an injected
+    packer that truncates the top bit of ``width``-bit codes (declares
+    the width, packs width-1) corrupts in-range codes and must fire for
+    each of 2/3/4 — the widths QSGD/homoqsgd select via pack_width."""
+    from grace_tpu.ops.packing import pack_widths
+
+    good = {w: (p, u) for w, p, u in pack_widths()}
+    narrow_pack, _ = good[width - 1]
+    _, wide_unpack = good[width]
+
+    def truncating_pack(codes):
+        # drop the MSB, pack at width-1: ceil(n*(width-1)/8) bytes — both
+        # the byte-count contract and the round-trip break
+        return narrow_pack(codes & jnp.uint8((1 << (width - 1)) - 1))
+
+    grace = build_grace({"name": "x",
+                         "params": {"compressor": "qsgd", "quantum_num": 7,
+                                    "memory": "none",
+                                    "communicator": "allgather"}})
+    t = trace_update(grace, name=f"bad-{width}bit", meta={"grace": grace})
+    findings = flow._packing_findings(
+        t, pack_fns=((width, truncating_pack, wide_unpack),))
+    assert findings
+    assert all("ops/packing" in f.message and f"{width}-bit" in f.message
+               for f in findings)
 
 
 def test_packing_check_only_runs_for_packed_payloads():

@@ -1,8 +1,10 @@
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
-from grace_tpu.ops import (pack_2bit, pack_4bit, pack_bits, unpack_2bit,
-                           unpack_4bit, unpack_bits)
+from grace_tpu.ops import (pack_2bit, pack_3bit, pack_4bit, pack_bits,
+                           unpack_2bit, unpack_3bit, unpack_4bit,
+                           unpack_bits)
 
 
 def test_pack_bits_roundtrip(rng):
@@ -25,6 +27,26 @@ def test_pack_2bit_roundtrip(rng):
         np.testing.assert_array_equal(np.asarray(out), codes)
 
 
+def test_pack_3bit_roundtrip(rng):
+    for n in [1, 2, 3, 7, 8, 9, 17, 1000]:
+        codes = rng.integers(0, 8, size=n).astype(np.uint8)
+        packed = pack_3bit(jnp.asarray(codes))
+        assert packed.dtype == jnp.uint8
+        assert packed.shape == (-(-3 * n // 8),)
+        out = unpack_3bit(packed, n)
+        np.testing.assert_array_equal(np.asarray(out), codes)
+
+
+def test_pack_3bit_lsb_first_bitstream():
+    """The declared 3-bit layout: bit b of code l is global bit 3l+b,
+    global bit 8j+k is bit k of byte j — pinned so the fused Pallas
+    bit-plane decode can never disagree with the reference packer."""
+    # codes [0b101, 0b011, 0b110] -> bitstream (LSB-first per code)
+    # 1,0,1, 1,1,0, 0,1,1 -> byte0 = 0b10011101 = 157, byte1 = 0b1
+    packed = np.asarray(pack_3bit(jnp.asarray([5, 3, 6], dtype=jnp.uint8)))
+    assert packed.tolist() == [0b10011101, 0b1]
+
+
 def test_pack_4bit_roundtrip(rng):
     for n in [1, 2, 3, 17, 1000]:
         codes = rng.integers(0, 16, size=n).astype(np.uint8)
@@ -43,11 +65,13 @@ def test_pack_4bit_low_nibble_first():
 
 
 def test_pack_widths_declares_all_packers():
-    """The numeric-safety audit contract covers 1/2/4-bit packers — the
-    4-bit entry is what puts QSGD's packed wire format under audit."""
+    """The numeric-safety audit contract covers every shipped width —
+    1-bit (sign masks), 2-bit (qsgd/homoqsgd at quantum_num<=1), 3-bit
+    (<=3) and 4-bit (<=7): each new width joins the flow pass-6 audit the
+    moment it joins this tuple."""
     from grace_tpu.ops.packing import pack_widths
     widths = {w for w, _, _ in pack_widths()}
-    assert widths == {1, 2, 4}
+    assert widths == {1, 2, 3, 4}
     for width, pack, unpack in pack_widths():
         n = 9
         codes = np.full((n,), (1 << width) - 1, np.uint8)
@@ -55,3 +79,20 @@ def test_pack_widths_declares_all_packers():
         assert packed.size == -(-n * width // 8)
         np.testing.assert_array_equal(
             np.asarray(unpack(jnp.asarray(packed), n)), codes)
+
+
+@pytest.mark.parametrize("n", [1, 3, 5, 7, 9, 11, 13, 17, 23, 63, 97, 255])
+def test_roundtrip_property_every_width_odd_lengths(rng, n):
+    """Round-trip property across the full width × odd-length grid: any
+    in-range code vector reconstructs exactly and the byte count matches
+    the declared ceil(n*width/8) — odd lengths exercise every partial
+    tail byte (1-bit: n%8, 2-bit: n%4, 3-bit: straddled boundaries,
+    4-bit: n%2)."""
+    from grace_tpu.ops.packing import pack_widths
+    for width, pack, unpack in pack_widths():
+        codes = rng.integers(0, 1 << width, size=n).astype(np.uint8)
+        packed = np.asarray(pack(jnp.asarray(codes)))
+        assert packed.dtype == np.uint8
+        assert packed.size == -(-n * width // 8), (width, n)
+        got = np.asarray(unpack(jnp.asarray(packed), n)).astype(np.uint8)
+        np.testing.assert_array_equal(got, codes, err_msg=f"w={width} n={n}")

@@ -394,6 +394,78 @@ def test_evidence_staleness_detector():
     assert marked["stale_reasons"]
 
 
+# ---------------------------------------------------------------------------
+# graft-wire tuner integration (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_price_candidate_wire_pipeline_discount():
+    """The double-buffered ring's declared overlap fraction discounts the
+    compressed wire leg — and ONLY that leg: link bytes are
+    pipeline-invariant and the dense bracket always rides the flat
+    undiscounted psum."""
+    from grace_tpu.tuning.cost import price_candidate
+    structs = model_structs("toy")
+    base = {"compressor": "qsgd", "quantum_num": 7, "use_pallas": False,
+            "memory": "none", "communicator": "ring", "fusion": "flat"}
+    serial = price_candidate(grace_from_params(base), structs, W8)
+    piped = price_candidate(
+        grace_from_params({**base, "pipeline": 2}), structs, W8)
+    assert serial["wire_pipeline_overlap"] == 0.0
+    assert piped["wire_pipeline_overlap"] == 0.25   # 0.5 * (2-1)/2
+    # same bytes on the wire — the discount models overlap, not volume
+    for k in ("payload_bytes", "ici_bytes", "dcn_bytes", "wire_ms"):
+        assert piped[k] == serial[k], k
+    assert piped["projected_step_ms"] == pytest.approx(
+        0.75 * serial["projected_step_ms"], abs=1e-9)   # record rounds @9dp
+    assert piped["dense_projected_step_ms"] == \
+        serial["dense_projected_step_ms"]
+    # deeper buffering asymptotes at the declared efficiency cap
+    p4 = price_candidate(
+        grace_from_params({**base, "pipeline": 4}), structs, W8)
+    assert p4["wire_pipeline_overlap"] == 0.375     # 0.5 * (4-1)/4
+
+
+def test_pipelined_variant_candidate_registered_and_audits_clean():
+    """The tuner-generated pipelined ring variant is a legal candidate, a
+    first-class lint registry entry, and traces clean — flow pass 5's
+    pipelined-chain referee is the static backing for the pricing
+    discount, so the discounted candidate can never be an audit blind
+    spot."""
+    from grace_tpu.analysis import AUDIT_CONFIGS, audit_config
+    name = "tune-qsgd4-ring-packed-pipelined"
+    assert name in {n for n, _, _ in variant_audit_entries()}
+    cand = next(c for c in enumerate_candidates(W8) if c.name == name)
+    assert cand.params["pipeline"] == 2
+    legal, reason, _ = candidate_legal(cand, W8)
+    assert legal, reason
+    entry = next(e for e in AUDIT_CONFIGS if e["name"] == name)
+    findings = audit_config(entry)
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_numeric_gate_shared_scale_2bit():
+    """The 2-bit shared-scale accumulator bound: accum_bits=2 at q=1
+    holds ONE level sum (payload_sum_max_world=1), so any multi-rank
+    topology dies in the numeric stage — the same single constant the
+    communicators raise on a live mesh and flow pass 6 flags statically."""
+    homo2 = grace_from_params({
+        "compressor": "homoqsgd", "quantum_num": 1, "accum_bits": 2,
+        "use_pallas": False, "memory": "residual", "communicator": "ring",
+        "fusion": "flat"})
+    assert homo2.compressor.payload_sum_max_world() == 1
+    reason = numeric_verdict(homo2, TuneTopology(world=2))
+    assert reason is not None and "payload_sum_max_world=1" in reason
+    # the 4-bit sibling survives exactly to its own bound (7) and no
+    # further — the registry's world=4 audit override is inside it
+    homo4 = grace_from_params({
+        "compressor": "homoqsgd", "quantum_num": 1, "accum_bits": 4,
+        "use_pallas": False, "memory": "residual", "communicator": "ring",
+        "fusion": "flat"})
+    assert numeric_verdict(homo4, TuneTopology(world=4)) is None
+    r8 = numeric_verdict(homo4, W8)
+    assert r8 is not None and "payload_sum_max_world=7" in r8
+
+
 def test_evidence_summary_stale_banner(tmp_path, monkeypatch):
     evidence_summary = _load_tool("evidence_summary")
     monkeypatch.setattr(evidence_summary, "ROOT", str(tmp_path))

@@ -394,6 +394,16 @@ def main(argv=None) -> int:
                          "audit of this smoke's own grace config); "
                          "findings land in the telemetry artifact as "
                          "lint_finding events and fail the smoke")
+    ap.add_argument("--pipeline", type=int, default=0,
+                    help="ride the double-buffered wire path (ISSUE 19): "
+                         "packed 4-bit qsgd over a ring with pipeline=N "
+                         "segments (unless --homo/--hier already chose "
+                         "the codec/communicator, which then just gain "
+                         "pipeline=N). With --lint, the static audit "
+                         "traces the FUSED spelling (use_pallas=True → "
+                         "interpret-mode wire kernels inside the audited "
+                         "graph) and flow pass 5 must count >= N "
+                         "independent chains before chaos runs")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -514,6 +524,20 @@ def main(argv=None) -> int:
         grace_params.update(communicator="hier",
                             slice_size=args.slice_size,
                             fusion="flat")
+    if args.pipeline > 1:
+        # graft-wire scenario (ISSUE 19): the double-buffered ring. The
+        # RUN rides use_pallas='auto' (staged off-TPU, kernel on-chip —
+        # bit-identical either way, the pack_widths contract); the --lint
+        # audit below flips to use_pallas=True so the fused
+        # decode→accumulate kernels trace INSIDE the audited pipelined
+        # graph. --homo/--hier keep their own codec/communicator and just
+        # gain the segmented schedule.
+        if not (args.homo or args.hier):
+            grace_params.update(compressor="qsgd", quantum_num=7,
+                                use_pallas="auto", communicator="ring",
+                                fusion="flat")
+            grace_params.pop("compress_ratio", None)
+        grace_params["pipeline"] = args.pipeline
     grc = grace_from_params(grace_params)
     grc = dataclasses.replace(grc, communicator=ChaosCommunicator(
         inner=grc.communicator, nan_prob=args.nan_prob, rank=args.rank,
@@ -573,9 +597,16 @@ def main(argv=None) -> int:
         # as the guard/consensus trail; errors fail the smoke fast.
         from grace_tpu.analysis import audit_config, run_repo_rules
         from grace_tpu.analysis.report import emit_to_sink
+        lint_params = dict(grace_params)
+        if args.pipeline > 1:
+            # Audit the FUSED spelling of the pipelined wire: forcing the
+            # kernels on (interpret off-TPU) puts the decode→accumulate
+            # hops inside the audited graph, and flow pass 5's referee
+            # must count >= pipeline independent chains per bucket.
+            lint_params["use_pallas"] = True
         lint_findings = run_repo_rules() + audit_config(
             {"name": "chaos_smoke-config",
-             "params": grace_params,
+             "params": lint_params,
              # Everything except wire reconciliation (the escape cond makes
              # the wire cost bimodal, same exclusion as the registry's
              # escape entries) — the graft-flow passes (schedulability,
