@@ -50,14 +50,17 @@ _CALLBACK_PRIMS = frozenset({
     "io_callback", "debug_callback", "pure_callback", "callback",
     "outside_call", "host_callback_call"})
 
-# The last three (graft-flow, ISSUE 9) live in analysis/flow.py on the
-# dependence-graph layer and are resolved lazily by run_passes — the names
-# are plain strings here so config registration and CLI selection never
-# import flow (which imports this module) at module-load time.
+# Passes 5-7 (graft-flow, ISSUE 9) live in analysis/flow.py on the
+# dependence-graph layer and passes 8-10 (graft-sound, ISSUE 20) in
+# analysis/state_passes.py on the stateful-semantics layer; both sets are
+# resolved lazily by run_passes — the names are plain strings here so
+# config registration and CLI selection never import those modules (which
+# import this module) at module-load time.
 PASS_NAMES = ("collective_consistency", "bit_exactness",
               "wire_reconciliation", "signature_stability",
               "overlap_schedulability", "numeric_safety",
-              "memory_footprint")
+              "memory_footprint", "rng_lineage", "rollback_coverage",
+              "replication_contract")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -738,20 +741,21 @@ _PASS_FNS = {
 
 
 def _resolve_pass(name: str):
-    """Pass function by name; loads the graft-flow module on first use of
-    one of its passes (flow imports this module, so eager registration
-    would be a cycle)."""
+    """Pass function by name; loads the graft-flow and graft-sound modules
+    on first use of one of their passes (both import this module, so eager
+    registration would be a cycle)."""
     fn = _PASS_FNS.get(name)
     if fn is None:
-        from grace_tpu.analysis import flow
+        from grace_tpu.analysis import flow, state_passes
         _PASS_FNS.update(flow.PASS_FNS)
+        _PASS_FNS.update(state_passes.PASS_FNS)
         fn = _PASS_FNS[name]
     return fn
 
 
 def run_passes(traced: TracedGraph,
                passes: Optional[Tuple[str, ...]] = None) -> List[Finding]:
-    """Run the named passes (default: all seven) over one traced graph."""
+    """Run the named passes (default: all ten) over one traced graph."""
     out: List[Finding] = []
     for name in (passes if passes is not None else PASS_NAMES):
         out.extend(_resolve_pass(name)(traced))

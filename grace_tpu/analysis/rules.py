@@ -21,6 +21,13 @@ forever instead of living in review-comment folklore.
   ``tests/``/``tools/`` must be registered in ``pyproject.toml`` (pytest
   only warns on unknown markers, so a typo'd marker silently drops tests
   from ``-m`` selections).
+* ``grace-state-field-roles`` — every field in the ``GraceState`` class
+  body must appear in exactly one of ``GRACE_VARYING_FIELDS`` /
+  ``GRACE_REPLICATED_FIELDS``. Those constants drive ``partition_specs``,
+  elastic world-resize carry, the guard's rollback contract, and the
+  replication-contract lint pass; a field in neither silently gets no
+  layout and no audit. The rule catches the drift at the AST before the
+  new field is ever traced.
 
 ``run_repo_rules(sources=...)`` accepts an in-memory ``{relpath: source}``
 override so the seeded-bad-source tests can prove each rule fires without
@@ -40,7 +47,7 @@ __all__ = ["RULE_NAMES", "run_repo_rules", "repo_root",
            "registered_markers"]
 
 RULE_NAMES = ("compressor-capabilities", "telemetry-fields-reducer",
-              "pytest-marker-registration")
+              "pytest-marker-registration", "grace-state-field-roles")
 
 _REQUIRED_CAPS = ("payload_algebra", "supports_hop_requant")
 _KNOWN_REDUCERS = {"first", "mean", "max", "min", "sum"}
@@ -247,10 +254,90 @@ def rule_pytest_markers(root: str, sources=None) -> List[Finding]:
     return findings
 
 
+def _tuple_literal(tree: ast.Module, name: str) -> Optional[set]:
+    """The string elements of a module-level ``name = ("a", "b", ...)``
+    assignment, or None when absent/not a literal."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    elts = node.value.elts
+                    if all(isinstance(e, ast.Constant)
+                           and isinstance(e.value, str) for e in elts):
+                        return {e.value for e in elts}
+    return None
+
+
+def rule_grace_state_field_roles(root: str, sources=None) -> List[Finding]:
+    rel = os.path.join("grace_tpu", "transform.py")
+    src = _read(root, rel, sources)
+    if src is None:
+        return [Finding(pass_name="grace-state-field-roles", config=rel,
+                        severity="error", message="transform.py not found")]
+    tree = ast.parse(src)
+    cls = next((n for n in ast.walk(tree)
+                if isinstance(n, ast.ClassDef) and n.name == "GraceState"),
+               None)
+    if cls is None:
+        return [Finding(pass_name="grace-state-field-roles", config=rel,
+                        severity="error",
+                        message="GraceState class not found")]
+    varying = _tuple_literal(tree, "GRACE_VARYING_FIELDS")
+    replicated = _tuple_literal(tree, "GRACE_REPLICATED_FIELDS")
+    findings: List[Finding] = []
+    if varying is None or replicated is None:
+        missing = [n for n, v in (("GRACE_VARYING_FIELDS", varying),
+                                  ("GRACE_REPLICATED_FIELDS", replicated))
+                   if v is None]
+        return [Finding(
+            pass_name="grace-state-field-roles", config=rel,
+            severity="error",
+            message=(f"{'/'.join(missing)} string-tuple literal not found "
+                     "in transform.py — the field-role constants must "
+                     "stay statically readable"))]
+    # Field names come from the class body's annotated assignments, so a
+    # freshly added field is caught before it is ever traced.
+    fields = [n.target.id for n in cls.body
+              if isinstance(n, ast.AnnAssign)
+              and isinstance(n.target, ast.Name)]
+    for f in fields:
+        if f not in varying and f not in replicated:
+            findings.append(Finding(
+                pass_name="grace-state-field-roles",
+                config=f"{rel}:{cls.lineno}", severity="error",
+                message=(
+                    f"GraceState field {f!r} appears in neither "
+                    "GRACE_VARYING_FIELDS nor GRACE_REPLICATED_FIELDS — "
+                    "add it to GRACE_VARYING_FIELDS (per-rank data, "
+                    "sharded by partition_specs, re-initialized on "
+                    "elastic resize) or GRACE_REPLICATED_FIELDS "
+                    "(bit-identical across ranks, carried through "
+                    "resize); without a role the field gets no layout, "
+                    "no rollback audit, and no replication check"),
+                details=(("field", f),)))
+        if f in varying and f in replicated:
+            findings.append(Finding(
+                pass_name="grace-state-field-roles",
+                config=f"{rel}:{cls.lineno}", severity="error",
+                message=(f"GraceState field {f!r} appears in BOTH "
+                         "field-role constants — the roles are exclusive"),
+                details=(("field", f),)))
+    for f in sorted((varying | replicated) - set(fields)):
+        findings.append(Finding(
+            pass_name="grace-state-field-roles", config=rel,
+            severity="error",
+            message=(f"field-role constants name {f!r}, which is not a "
+                     "GraceState field — stale entry after a rename?"),
+            details=(("field", f),)))
+    return findings
+
+
 _RULE_FNS = {
     "compressor-capabilities": rule_compressor_capabilities,
     "telemetry-fields-reducer": rule_telemetry_fields,
     "pytest-marker-registration": rule_pytest_markers,
+    "grace-state-field-roles": rule_grace_state_field_roles,
 }
 
 

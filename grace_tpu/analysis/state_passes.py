@@ -1,0 +1,608 @@
+"""graft-sound: the three stateful-semantics audit passes (8–10).
+
+Passes 1–7 audit what the traced program *does* — which collectives it
+issues, what bytes cross the wire, whether its numerics saturate. These
+three audit what the program does **to its state**, the contract class
+every stateful-compression bug lives in:
+
+* **pass 8 ``rng_lineage``** — PRNG keys form a derivation DAG
+  (``random_wrap`` roots, ``random_fold_in``/``random_split`` edges,
+  ``random_bits`` consumptions). QSGD-style unbiasedness requires
+  *independent* stochastic draws per site: two independent consumer sites
+  sharing a lineage draw **correlated** quantization noise, and the bias
+  that correlation injects scales with world size. The pass reconstructs
+  every consumption's lineage path and condemns (a) two
+  branch-compatible consumptions of the same lineage with *different*
+  draw shapes (a deliberate re-draw of the identical shape is the
+  telemetry probe / CSE idiom and is exempt — XLA folds it into one
+  draw), and (b) a draw from a **rank-varying** key: ``rng_key`` is a
+  replicated field precisely so every rank runs the same schedule
+  (cyclictopk's rank-deterministic rotation, shared Top-K negotiation);
+  a per-rank key silently breaks that agreement.
+
+* **pass 9 ``rollback_coverage``** — the guard's atomicity contract: on
+  a bad step *every* state leaf (params via zeroed updates, optimizer
+  state, every GraceState leaf) must be restored bitwise, except the
+  leaves :data:`grace_tpu.resilience.guard.GUARD_ROLLBACK_EXCLUDED`
+  declares written-through (the guard's own counters, the forward
+  ``fallback`` decision). The rollback is ``jnp.where`` selects gated by
+  the non-finiteness flag, so the proof obligation is dataflow: a state
+  output either *is* its input var, or descends from a ``select_n``
+  whose predicate descends from the ``is_finite`` scan and whose
+  operands had access to that leaf's input. A new state field that skips
+  rollback fails that proof at trace time — not in a chaos drill.
+
+* **pass 10 ``replication_contract``** — at step exit every
+  ``GRACE_REPLICATED_FIELDS`` leaf must be *provably* replicated over
+  every mesh axis (the same forward rank-variance dataflow pass 1 uses,
+  but per output position through the consensus ``cond``), every
+  ``GRACE_VARYING_FIELDS`` field should actually vary, and the two
+  hand-kept constants are reconciled against ``GraceState._fields`` and
+  ``transform.partition_specs`` at 1-D and 2-D meshes so the three
+  spellings of the one layout contract can never drift apart.
+
+All three share one abstract-interpretation walk over the body jaxpr
+(cached per ``TracedGraph``), tracking per var: the set of state-input
+leaves it depends on, guard-select coverage, descent from the guard's
+non-finiteness scan, per-mesh-axis rank variance, and PRNG lineage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+from grace_tpu.analysis.passes import (Finding, _ALLTOALL, _GATHERS,
+                                       _PERMUTES, _REDUCTIONS, _SCATTER,
+                                       _axes_of, _is_var, _stage_of,
+                                       _sub_jaxprs_of)
+from grace_tpu.analysis.trace import TracedGraph
+
+__all__ = ["STATE_PASS_NAMES", "PASS_FNS", "pass_rng_lineage",
+           "pass_rollback_coverage", "pass_replication_contract"]
+
+STATE_PASS_NAMES = ("rng_lineage", "rollback_coverage",
+                    "replication_contract")
+
+# Abstract value per jaxpr var: a 5-tuple indexed by these constants.
+#   DEP   int bitmask over state-input leaves this value depends on
+#   GMASK int bitmask: state leaves i such that the value descends from a
+#         guard-gated select_n (predicate descends from is_finite) whose
+#         operands depended on leaf i — the rollback-coverage evidence
+#   GPRED bool: descends from an is_finite scan (the guard's bad flag)
+#   VAR   int bitmask over mesh axes: rank-varying on that axis
+#   LIN   PRNG lineage tuple, or None for non-key values
+_DEP, _GMASK, _GPRED, _VAR, _LIN = range(5)
+_ZERO = (0, 0, False, 0, None)
+
+# Unary shape/dtype ops that forward a key value (and its lineage)
+# unchanged in derivation terms.
+_LIN_PASSTHROUGH = frozenset({
+    "squeeze", "reshape", "broadcast_in_dim", "convert_element_type",
+    "transpose", "copy", "random_unwrap", "random_wrap"})
+
+
+@dataclasses.dataclass(frozen=True)
+class _Draw:
+    """One stochastic consumption site (``random_bits`` / raw threefry)."""
+
+    lineage: Optional[Tuple]   # key derivation path, None = untracked
+    shape: Tuple[int, ...]     # draw output shape
+    dtype: str                 # draw output dtype
+    ctx: Tuple                 # ((branch_site, branch_idx), ...) context
+    stage: str                 # grace/... trace scope
+    varmask: int               # mesh-axis variance of the consumed key
+    prim: str                  # consuming primitive name
+
+
+class _Walker:
+    """One forward abstract-interpretation walk over a body jaxpr."""
+
+    def __init__(self, axes: Tuple[str, ...], rng_bits: int):
+        self.axes = axes
+        self.axis_bit = {a: 1 << i for i, a in enumerate(axes)}
+        self.rng_bits = rng_bits       # state-leaf bits holding rng_key
+        self.env: Dict[Any, Tuple] = {}
+        self.draws: List[_Draw] = []
+        self._tokens: Dict[Any, int] = {}
+        self._sites = 0
+
+    # -- lineage tokens: stable identity for fold data / root operands ----
+    def _token(self, v):
+        if not _is_var(v):
+            return ("lit", str(getattr(v, "val", v)))
+        t = self._tokens.get(v)
+        if t is None:
+            t = self._tokens[v] = len(self._tokens)
+        return ("var", t)
+
+    def _get(self, v) -> Tuple:
+        if not _is_var(v):
+            return _ZERO
+        return self.env.get(v, _ZERO)
+
+    def _join(self, vals) -> Tuple:
+        dep = gmask = var = 0
+        gpred = False
+        for a in vals:
+            dep |= a[_DEP]
+            gmask |= a[_GMASK]
+            gpred = gpred or a[_GPRED]
+            var |= a[_VAR]
+        return (dep, gmask, gpred, var, None)
+
+    # -- the walk ---------------------------------------------------------
+    def walk(self, jaxpr, ctx: Tuple = ()):
+        for v in jaxpr.constvars:
+            self.env.setdefault(v, _ZERO)
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, ctx)
+
+    def _eqn(self, eqn, ctx: Tuple):
+        name = eqn.primitive.name
+        ins = [self._get(v) for v in eqn.invars]
+        joined = self._join(ins)
+        out = joined
+
+        if name == "axis_index":
+            var = joined[_VAR]
+            for a in _axes_of(eqn):
+                var |= self.axis_bit.get(a, 0)
+            out = (joined[_DEP], joined[_GMASK], joined[_GPRED], var, None)
+        elif name in _REDUCTIONS or name in _GATHERS:
+            # Full-axis reduction/gather: every rank computes the identical
+            # result over that axis (axis_index_groups would break that).
+            var = joined[_VAR]
+            if eqn.params.get("axis_index_groups") is None:
+                for a in _axes_of(eqn):
+                    var &= ~self.axis_bit.get(a, 0)
+            out = (joined[_DEP], joined[_GMASK], joined[_GPRED], var, None)
+        elif name in _PERMUTES or name in _ALLTOALL or name in _SCATTER:
+            var = joined[_VAR]
+            for a in _axes_of(eqn):
+                var |= self.axis_bit.get(a, 0)
+            out = (joined[_DEP], joined[_GMASK], joined[_GPRED], var, None)
+        elif name == "is_finite":
+            out = (joined[_DEP], joined[_GMASK], True, joined[_VAR], None)
+        elif name == "select_n":
+            pred, data = ins[0], ins[1:]
+            dj = self._join(data)
+            gmask = dj[_GMASK] | pred[_GMASK]
+            if pred[_GPRED]:
+                # A guard-gated select: whatever state leaves its operands
+                # could restore, the output is covered for.
+                gmask |= dj[_DEP]
+            lins = {a[_LIN] for a in data}
+            lin = lins.pop() if len(lins) == 1 else None
+            out = (dj[_DEP] | pred[_DEP], gmask,
+                   dj[_GPRED] or pred[_GPRED], dj[_VAR] | pred[_VAR], lin)
+        elif name == "random_wrap":
+            src = ins[0] if ins else _ZERO
+            lin = src[_LIN]
+            if lin is None:
+                root_dep = src[_DEP] & self.rng_bits
+                if root_dep:
+                    lin = (("root", root_dep),)
+                else:
+                    lin = (("root", self._token(eqn.invars[0])),)
+            out = (joined[_DEP], joined[_GMASK], joined[_GPRED],
+                   joined[_VAR], lin)
+        elif name == "random_fold_in":
+            key = ins[0] if ins else _ZERO
+            lin = None
+            if key[_LIN] is not None and len(eqn.invars) > 1:
+                lin = key[_LIN] + (("fold", self._token(eqn.invars[1])),)
+            out = (joined[_DEP], joined[_GMASK], joined[_GPRED],
+                   joined[_VAR], lin)
+        elif name == "random_split":
+            key = ins[0] if ins else _ZERO
+            lin = (key[_LIN] + (("split",),)
+                   if key[_LIN] is not None else None)
+            out = (joined[_DEP], joined[_GMASK], joined[_GPRED],
+                   joined[_VAR], lin)
+        elif name in ("slice", "dynamic_slice"):
+            src = ins[0] if ins else _ZERO
+            lin = src[_LIN]
+            if lin is not None:
+                if name == "slice":
+                    at = tuple(eqn.params.get("start_indices", ()))
+                else:
+                    at = tuple(self._token(v) for v in eqn.invars[1:])
+                lin = lin + (("at", at),)
+            out = (joined[_DEP], joined[_GMASK], joined[_GPRED],
+                   joined[_VAR], lin)
+        elif name in _LIN_PASSTHROUGH and len(eqn.invars) == 1:
+            out = (joined[_DEP], joined[_GMASK], joined[_GPRED],
+                   joined[_VAR], ins[0][_LIN])
+        elif name == "random_bits":
+            key = ins[0] if ins else _ZERO
+            self._record(eqn, key, ctx)
+        elif name == "threefry2x32":
+            # Raw counter-mode use (a codec bypassing the key dtype): a
+            # consumption when any operand carries lineage.
+            keyed = [a for a in ins if a[_LIN] is not None]
+            if keyed:
+                self._record(eqn, keyed[0], ctx)
+        elif name == "cond":
+            out = self._cond(eqn, ins, ctx)
+            if out is not None:
+                return                      # outputs already bound
+            out = joined
+        else:
+            subs = _sub_jaxprs_of(eqn)
+            if subs:
+                out = self._call(eqn, subs, ins, joined, ctx)
+                if out is None:
+                    return                  # outputs already bound
+        for v in eqn.outvars:
+            self.env[v] = out
+
+    def _record(self, eqn, key: Tuple, ctx: Tuple):
+        aval = eqn.outvars[0].aval
+        self.draws.append(_Draw(
+            lineage=key[_LIN], shape=tuple(aval.shape),
+            dtype=str(aval.dtype), ctx=ctx, stage=_stage_of(eqn),
+            varmask=key[_VAR], prim=eqn.primitive.name))
+
+    def _cond(self, eqn, ins, ctx: Tuple):
+        """Per-position branch join: dep/variance union, coverage
+        intersection (a leaf is only *proven* restored when every branch
+        restores it), predicate variance OR-ed into every output — the
+        per-position precision is what keeps the consensus ``cond``'s
+        replicated state passthroughs provably replicated."""
+        site = self._sites
+        self._sites += 1
+        pred = ins[0] if ins else _ZERO
+        ops = eqn.invars[1:]
+        branches = [getattr(b, "jaxpr", b) for b in eqn.params["branches"]]
+        branch_outs = []
+        passthrough = []     # per branch: outvar position -> operand index
+        for k, sub in enumerate(branches):
+            if len(sub.invars) == len(ops):
+                for sv, ov in zip(sub.invars, ops):
+                    self.env[sv] = self._get(ov)
+                iv_index = {sv: m for m, sv in enumerate(sub.invars)}
+                passthrough.append({j: iv_index[ov]
+                                    for j, ov in enumerate(sub.outvars)
+                                    if _is_var(ov) and ov in iv_index})
+            else:
+                coarse = self._join(ins)
+                for sv in sub.invars:
+                    self.env[sv] = coarse
+                passthrough.append({})
+            self.walk(sub, ctx + ((site, k),))
+            branch_outs.append([self._get(ov) for ov in sub.outvars])
+        if not all(len(b) == len(eqn.outvars) for b in branch_outs):
+            return None
+        for j, v in enumerate(eqn.outvars):
+            # Passthrough refinement: when EVERY branch forwards the same
+            # operand untouched, the output equals that operand no matter
+            # which branch runs — the predicate's variance is irrelevant.
+            # This is what keeps replicated state leaves provably
+            # replicated through an audit cond whose predicate is
+            # legitimately shard-varying.
+            fwd = {p.get(j, -1 - k) for k, p in enumerate(passthrough)}
+            if len(fwd) == 1:
+                self.env[v] = self._get(ops[fwd.pop()])
+                continue
+            cols = [b[j] for b in branch_outs]
+            dep = pred[_DEP]
+            var = pred[_VAR]
+            gmask = cols[0][_GMASK]
+            gpred = pred[_GPRED]
+            lins = {c[_LIN] for c in cols}
+            for c in cols:
+                dep |= c[_DEP]
+                var |= c[_VAR]
+                gmask &= c[_GMASK]
+                gpred = gpred or c[_GPRED]
+            self.env[v] = (dep, gmask, gpred, var,
+                           lins.pop() if len(lins) == 1 else None)
+        return True
+
+    def _call(self, eqn, subs, ins, joined, ctx: Tuple):
+        """pjit/closed_call/scan/remat: single sub-jaxpr with matching
+        arities maps per position (scan's carry+xs arities line up too);
+        anything else falls back to the coarse join — still walked, so
+        consumptions inside are never missed."""
+        if len(subs) == 1 and len(subs[0].invars) == len(eqn.invars):
+            sub = subs[0]
+            for sv, ov in zip(sub.invars, eqn.invars):
+                self.env[sv] = self._get(ov)
+            self.walk(sub, ctx)
+            if len(sub.outvars) == len(eqn.outvars):
+                for v, ov in zip(eqn.outvars, sub.outvars):
+                    self.env[v] = self._get(ov)
+                return None
+            return joined
+        coarse = (joined[_DEP], joined[_GMASK], joined[_GPRED],
+                  joined[_VAR], None)
+        for sub in subs:
+            for sv in sub.invars:
+                self.env[sv] = coarse
+            self.walk(sub, ctx)
+        return coarse
+
+
+def _analyze(traced: TracedGraph) -> _Walker:
+    """The shared walk, cached on the TracedGraph (one walk serves all
+    three passes in a ``run_passes`` sweep)."""
+    cached = traced.meta.get("_graft_sound")
+    if cached is not None:
+        return cached
+    axes = traced.axes
+    rng_bits = 0
+    for i, (path, _v) in enumerate(traced.state_in_vars):
+        if _field_of(path, traced.grace_prefixes) == "rng_key":
+            rng_bits |= 1 << i
+    w = _Walker(axes, rng_bits)
+    leaf_bit = {}
+    for i, (_path, v) in enumerate(traced.state_in_vars):
+        leaf_bit[v] = leaf_bit.get(v, 0) | (1 << i)
+    for v in traced.body.invars:
+        var = 0
+        for ai, a in enumerate(axes):
+            if traced.varying_for(a).get(v, True):
+                var |= 1 << ai
+        dep = leaf_bit.get(v, 0)
+        # A key-dtype rng_key leaf is consumed without a random_wrap, so
+        # the lineage root is seeded on the invar itself.
+        lin = (("root", dep & rng_bits),) if dep & rng_bits else None
+        w.env[v] = (dep, 0, False, var, lin)
+    w.walk(traced.body)
+    traced.meta["_graft_sound"] = w
+    return w
+
+
+def _field_of(path: str, prefixes: Tuple[str, ...]) -> Optional[str]:
+    """The GraceState field a state-leaf path belongs to, or None for
+    non-grace leaves (params, guard counters, optimizer moments)."""
+    for pre in sorted(prefixes, key=len, reverse=True):
+        if pre == "":
+            return path.split("/", 1)[0]
+        if path.startswith(pre + "/"):
+            return path[len(pre) + 1:].split("/", 1)[0]
+    return None
+
+
+def _ctx_compatible(a: Tuple, b: Tuple) -> bool:
+    """Two draw sites can co-occur in one execution iff they agree on
+    every branch site they share (different arms of one cond/switch are
+    mutually exclusive — the adapt ladder's rungs never cross-correlate)."""
+    da = dict(a)
+    return all(da.get(site, k) == k for site, k in b)
+
+
+# ---------------------------------------------------------------------------
+# pass 8: rng lineage
+# ---------------------------------------------------------------------------
+
+def pass_rng_lineage(traced: TracedGraph) -> List[Finding]:
+    """Independent stochastic sites must consume independently derived
+    keys, and every consumed key must be rank-replicated."""
+    w = _analyze(traced)
+    findings: List[Finding] = []
+
+    for d in w.draws:
+        if d.varmask:
+            axes = [a for i, a in enumerate(traced.axes)
+                    if d.varmask & (1 << i)]
+            findings.append(Finding(
+                pass_name="rng_lineage", config=traced.name,
+                severity="error", stage=d.stage,
+                message=(
+                    f"stochastic draw ({d.prim} -> {d.dtype}{d.shape}) "
+                    f"consumes a rank-varying key (axes "
+                    f"{', '.join(axes)}) — rng_key is a replicated field "
+                    "so every rank draws the same schedule; a per-rank "
+                    "key desyncs rank-deterministic selection "
+                    "(cyclictopk rotation, shared Top-K negotiation)"),
+                details=(("axes", tuple(axes)), ("shape", d.shape))))
+
+    by_lin: Dict[Tuple, List[_Draw]] = {}
+    for d in w.draws:
+        if d.lineage is not None:
+            by_lin.setdefault(d.lineage, []).append(d)
+    reported = set()
+    for lin, group in by_lin.items():
+        for i in range(len(group)):
+            for j in range(i + 1, len(group)):
+                a, b = group[i], group[j]
+                if (a.shape, a.dtype) == (b.shape, b.dtype):
+                    # The identical re-draw: the telemetry error probe /
+                    # chunk-0 probe-encode idiom — XLA CSEs it into ONE
+                    # draw, so the sites are the same draw, not two
+                    # correlated ones.
+                    continue
+                if not _ctx_compatible(a.ctx, b.ctx):
+                    continue
+                key = (lin, tuple(sorted(((a.shape, a.dtype),
+                                          (b.shape, b.dtype)))))
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(Finding(
+                    pass_name="rng_lineage", config=traced.name,
+                    severity="error", stage=a.stage or b.stage,
+                    message=(
+                        f"two independent stochastic sites share one rng "
+                        f"lineage: {a.dtype}{a.shape} at "
+                        f"'{a.stage or '?'}' and {b.dtype}{b.shape} at "
+                        f"'{b.stage or '?'}' draw from the same derived "
+                        "key — correlated quantization noise breaks the "
+                        "unbiased-estimator contract; fold a distinct "
+                        "site index into each key"),
+                    details=(("shapes", (a.shape, b.shape)),
+                             ("stages", (a.stage, b.stage)))))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 9: rollback coverage
+# ---------------------------------------------------------------------------
+
+def pass_rollback_coverage(traced: TracedGraph) -> List[Finding]:
+    """Every state leaf the guarded step writes must be restored by a
+    guard-gated select or declared in ``GUARD_ROLLBACK_EXCLUDED``. Only
+    meaningful on guarded train-step traces (``meta['guard']``); update-
+    mode and unguarded traces have no rollback contract to audit."""
+    if traced.meta.get("guard") is None:
+        return []
+    if not traced.state_in_vars or not traced.state_out_vars:
+        return []
+    from grace_tpu.resilience.guard import GUARD_ROLLBACK_EXCLUDED
+
+    w = _analyze(traced)
+    excluded = set(GUARD_ROLLBACK_EXCLUDED)
+    findings: List[Finding] = []
+    for i, ((path, vin), (_po, vout)) in enumerate(
+            zip(traced.state_in_vars, traced.state_out_vars)):
+        if _is_var(vout) and vout is vin:
+            continue                       # passed through bitwise
+        if set(path.split("/")) & excluded:
+            continue                       # declared written-through
+        a = w._get(vout)
+        if a[_GMASK] & (1 << i):
+            continue                       # proven restored by a select
+        findings.append(Finding(
+            pass_name="rollback_coverage", config=traced.name,
+            severity="error",
+            message=(
+                f"state leaf '{path}' is written by the guarded step but "
+                "never restored by a rollback select: on a bad step its "
+                "new (possibly poisoned) value survives. Route it "
+                "through the guard's jnp.where rollback, or — if it is "
+                "deliberately written through — add its field to "
+                "resilience.guard.GUARD_ROLLBACK_EXCLUDED"),
+            details=(("path", path),)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 10: replication contract
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _contract_drift() -> Tuple[str, ...]:
+    """Static reconciliation of the three spellings of the layout
+    contract: the two field-role constants, ``GraceState._fields``, and
+    ``partition_specs`` at a 1-D and a 2-D mesh. Config-independent,
+    computed once per process."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from grace_tpu import transform as T
+
+    msgs: List[str] = []
+    rep, varf = set(T.GRACE_REPLICATED_FIELDS), set(T.GRACE_VARYING_FIELDS)
+    fields = set(T.GraceState._fields)
+    overlap = rep & varf
+    if overlap:
+        msgs.append(f"fields {sorted(overlap)} appear in BOTH "
+                    "GRACE_REPLICATED_FIELDS and GRACE_VARYING_FIELDS")
+    missing = fields - (rep | varf)
+    if missing:
+        msgs.append(f"GraceState fields {sorted(missing)} appear in "
+                    "neither GRACE_REPLICATED_FIELDS nor "
+                    "GRACE_VARYING_FIELDS — extend one of the constants")
+    ghost = (rep | varf) - fields
+    if ghost:
+        msgs.append(f"field-role constants name {sorted(ghost)} which are "
+                    "not GraceState fields")
+    if not set(T.GRACE_OBSERVATIONAL_FIELDS) <= varf:
+        msgs.append("GRACE_OBSERVATIONAL_FIELDS is not a subset of "
+                    "GRACE_VARYING_FIELDS")
+
+    leaf = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    state = T.GraceState(**{f: leaf for f in T.GraceState._fields})
+    for mesh in (T.MeshSpec(), T.MeshSpec(dp_axis="dp", fsdp_axis="fsdp")):
+        specs = T.partition_specs(state, mesh)
+        vspec = mesh.varying_spec()
+        for f in T.GraceState._fields:
+            got = getattr(specs, f)
+            want = vspec if f in varf else P()
+            if got != want:
+                msgs.append(
+                    f"partition_specs disagrees with the field-role "
+                    f"constants at mesh {mesh.axes}: field '{f}' gets "
+                    f"{got} but its role says {want}")
+    return tuple(msgs)
+
+
+def pass_replication_contract(traced: TracedGraph) -> List[Finding]:
+    """At step exit every replicated GraceState leaf must be provably
+    replicated over every mesh axis; varying fields should actually
+    vary; and the hand-kept constants must agree with partition_specs.
+
+    Consensus scoping: the audit/repair path's writes (masked-broadcast
+    repairs, divergence accounting) are functions of the fingerprint
+    comparison, which is *definitionally* per-shard data on any axis the
+    audit collectives don't span — their replication over non-exchange
+    axes holds by the healthy-run induction (identical inputs produce
+    identical decisions), not by dataflow, and no static analysis can
+    prove an induction over fault states. So on consensus-enabled traces
+    the replicated-leaf check applies to the exchange axis only — the
+    axis the repair broadcasts actually restore — while non-consensus
+    traces are checked over every mesh axis."""
+    findings = [
+        Finding(pass_name="replication_contract", config=traced.name,
+                severity="error", message=m, details=())
+        for m in _contract_drift()]
+    if not traced.state_out_vars:
+        return findings
+    from grace_tpu.transform import (GRACE_REPLICATED_FIELDS,
+                                     GRACE_VARYING_FIELDS)
+
+    w = _analyze(traced)
+    full = (1 << len(traced.axes)) - 1
+    check = full
+    if traced.meta.get("consensus"):
+        check = 1 << traced.axes.index(traced.axis_name)
+    field_var: Dict[Tuple[str, str], int] = {}
+    for path, vout in traced.state_out_vars:
+        field = _field_of(path, traced.grace_prefixes)
+        if field is None:
+            continue
+        a = w._get(vout)
+        if field in GRACE_REPLICATED_FIELDS and a[_VAR] & check:
+            axes = [ax for i, ax in enumerate(traced.axes)
+                    if a[_VAR] & check & (1 << i)]
+            findings.append(Finding(
+                pass_name="replication_contract", config=traced.name,
+                severity="error",
+                message=(
+                    f"replicated-field leaf '{path}' leaves the step "
+                    f"rank-varying over {', '.join(axes)} — a "
+                    "rank-varying write into a GRACE_REPLICATED_FIELDS "
+                    "field desyncs replicas (the adapt-rung desync "
+                    "class); make the write derive from full-axis "
+                    "collectives, or move the field to "
+                    "GRACE_VARYING_FIELDS and partition_specs"),
+                details=(("path", path), ("axes", tuple(axes)))))
+        if field in GRACE_VARYING_FIELDS:
+            k = (path.rsplit(field, 1)[0], field)
+            field_var[k] = field_var.get(k, 0) | a[_VAR]
+    for (_prefix, field), var in sorted(field_var.items()):
+        if var != full:
+            missing = [ax for i, ax in enumerate(traced.axes)
+                       if not (var & (1 << i))]
+            findings.append(Finding(
+                pass_name="replication_contract", config=traced.name,
+                severity="warning",
+                message=(
+                    f"varying field '{field}' has no leaf that actually "
+                    f"varies over {', '.join(missing)} — it is sharded "
+                    "by partition_specs but provably replicated; either "
+                    "the state is dead weight at world size or the "
+                    "field belongs in GRACE_REPLICATED_FIELDS"),
+                details=(("field", field), ("axes", tuple(missing)))))
+    return findings
+
+
+PASS_FNS = {
+    "rng_lineage": pass_rng_lineage,
+    "rollback_coverage": pass_rollback_coverage,
+    "replication_contract": pass_replication_contract,
+}

@@ -112,6 +112,22 @@ class TracedGraph:
     axis_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
     varying_axes: Dict[str, Dict[Any, bool]] = dataclasses.field(
         default_factory=dict)
+    # Aligned (path, body var) lists for the state portion of the traced
+    # signature — the *var* twins of ``state_in``/``state_out``, recorded so
+    # the stateful-semantics passes (graft-sound, :mod:`.state_passes`) can
+    # seed per-leaf dataflow from the actual jaxpr vars: rng-lineage roots
+    # (the ``rng_key`` leaf), rollback write-sets (every state leaf's
+    # input→output pair), and the step-exit replication check. Unlike
+    # ``state_in``, these ARE populated for train-step traces (the guard's
+    # rollback selects only exist there). ``grace_prefixes`` are the
+    # "/"-joined path prefixes of every GraceState node in the traced state
+    # tree ("" when the state IS a GraceState), so passes can classify a
+    # leaf path into its GraceState field without guessing.
+    state_in_vars: List[Tuple[str, Any]] = dataclasses.field(
+        default_factory=list)
+    state_out_vars: List[Tuple[str, Any]] = dataclasses.field(
+        default_factory=list)
+    grace_prefixes: Tuple[str, ...] = ()
 
     @property
     def axes(self) -> Tuple[str, ...]:
@@ -234,18 +250,32 @@ def _spec_mentions(spec, axis_name: str) -> bool:
 
 def _flat_paths(tree) -> List[str]:
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-    out = []
-    for path, _leaf in flat:
-        parts = []
-        for e in path:
-            for attr in ("name", "key", "idx"):
-                if hasattr(e, attr):
-                    parts.append(str(getattr(e, attr)))
-                    break
-            else:
-                parts.append(str(e))
-        out.append("/".join(parts))
-    return out
+    return [_path_str(path) for path, _leaf in flat]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for e in path:
+        for attr in ("name", "key", "idx"):
+            if hasattr(e, attr):
+                parts.append(str(getattr(e, attr)))
+                break
+        else:
+            parts.append(str(e))
+    return "/".join(parts)
+
+
+def _grace_prefixes(state_struct) -> Tuple[str, ...]:
+    """Path prefixes of every GraceState node embedded in ``state_struct``
+    ("" when the state itself is one) — recorded on the TracedGraph so the
+    graft-sound passes can map a state leaf path to its GraceState field
+    by structure, not by guessing at segment names."""
+    from grace_tpu.transform import GraceState
+
+    is_grace = lambda n: isinstance(n, GraceState)          # noqa: E731
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        state_struct, is_leaf=is_grace)
+    return tuple(_path_str(path) for path, node in flat if is_grace(node))
 
 
 def _varying_mask_from_specs(state_struct, axis_name: str) -> List[bool]:
@@ -411,6 +441,7 @@ def trace_update(grace, *, world: int = 8, params=None,
         axis_seeds[a] = dict(zip(inner.invars, _seeds_from_positions(
             positions, mask_a, len(inner.invars))))
     state_in = []
+    state_in_vars = []
     grad_in = []
     if positions is not None:
         # Body invar carrying outer arg leaf i (hoisted constants shift
@@ -418,11 +449,12 @@ def trace_update(grace, *, world: int = 8, params=None,
         arg_to_body = {i: p for p, i in enumerate(positions)
                        if isinstance(i, int)}
         paths = _flat_paths(state_struct)
-        state_in = [(p, inner.invars[arg_to_body[i]].aval)
-                    for i, p in enumerate(paths)
-                    if i in arg_to_body]
-        if len(state_in) != len(paths):          # a state leaf went missing
-            state_in = []
+        state_in_vars = [(p, inner.invars[arg_to_body[i]])
+                         for i, p in enumerate(paths)
+                         if i in arg_to_body]
+        if len(state_in_vars) != len(paths):     # a state leaf went missing
+            state_in_vars = []
+        state_in = [(p, v.aval) for p, v in state_in_vars]
         grad_in = [inner.invars[b] for i, b in sorted(arg_to_body.items())
                    if i >= len(state_flat)]
     # Replicated-by-contract state leaves (spec P() — replicated over
@@ -436,8 +468,10 @@ def trace_update(grace, *, world: int = 8, params=None,
     # next step re-traces against is the trailing slice.
     n_state = len(state_flat)
     state_out = []
+    state_out_vars = []
     if state_in and len(inner.outvars) >= n_state:
         out_tail = inner.outvars[len(inner.outvars) - n_state:]
+        state_out_vars = [(p, v) for (p, _), v in zip(state_in, out_tail)]
         state_out = [(p, v.aval)
                      for (p, _), v in zip(state_in, out_tail)]
     return TracedGraph(name=name, closed=closed, body=inner, world=dp,
@@ -448,7 +482,10 @@ def trace_update(grace, *, world: int = 8, params=None,
                        meta=dict(meta or {}),
                        mesh_axes=tuple(mesh_spec.axes),
                        axis_sizes={n: s for n, s in mesh_axes},
-                       varying_axes=axis_seeds)
+                       varying_axes=axis_seeds,
+                       state_in_vars=state_in_vars,
+                       state_out_vars=state_out_vars,
+                       grace_prefixes=_grace_prefixes(state_struct))
 
 
 def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
@@ -512,15 +549,32 @@ def trace_train_step(grace, *, world: int = 8, guard: Optional[dict] = None,
         axis_seeds[a] = dict(zip(inner.invars, _seeds_from_positions(
             positions, mask_a, len(inner.invars))))
     grad_in = []
+    state_in_vars = []
+    state_out_vars = []
     if positions is not None:
         arg_to_body = {i: p for p, i in enumerate(positions)
                        if isinstance(i, int)}
         grad_in = [inner.invars[b] for i, b in sorted(arg_to_body.items())
                    if i >= len(state_flat)]
+        # The step returns (TrainState, loss): the flattened outputs lead
+        # with the state leaves in the same path order the inputs carry.
+        paths = _flat_paths(state_struct)
+        state_in_vars = [(p, inner.invars[arg_to_body[i]])
+                         for i, p in enumerate(paths) if i in arg_to_body]
+        if len(state_in_vars) != len(paths):
+            state_in_vars = []
+        elif len(inner.outvars) >= len(paths):
+            state_out_vars = list(zip(paths, inner.outvars[:len(paths)]))
+    meta = dict(meta or {})
+    meta.setdefault("guard", guard)
+    meta.setdefault("consensus", consensus)
     return TracedGraph(name=name, closed=closed, body=inner, world=dp,
                        axis_name=axis_name,
                        varying=axis_seeds[mesh_spec.dp_axis],
-                       grad_in=grad_in, meta=dict(meta or {}),
+                       grad_in=grad_in, meta=meta,
                        mesh_axes=tuple(mesh_spec.axes),
                        axis_sizes={n: s for n, s in mesh_axes},
-                       varying_axes=axis_seeds)
+                       varying_axes=axis_seeds,
+                       state_in_vars=state_in_vars,
+                       state_out_vars=state_out_vars,
+                       grace_prefixes=_grace_prefixes(state_struct))

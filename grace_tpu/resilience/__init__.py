@@ -58,11 +58,14 @@ from grace_tpu.resilience.elastic import (ElasticController, ResizePlan,
                                           rejoin_barrier, replica_variants,
                                           reshard_grace_state,
                                           validate_resharded)
-from grace_tpu.resilience.guard import GuardState, guard_transform
+from grace_tpu.resilience.guard import (GUARD_ROLLBACK_EXCLUDED,
+                                        GUARD_SCAN_EXCLUDED_TYPES,
+                                        GuardState, guard_transform)
 from grace_tpu.resilience.retune import (RetuneController, StagedPromotion,
                                          state_digest)
 
-__all__ = ["GuardState", "guard_transform", "guarded_chain",
+__all__ = ["GUARD_ROLLBACK_EXCLUDED", "GUARD_SCAN_EXCLUDED_TYPES",
+           "GuardState", "guard_transform", "guarded_chain",
            "ChaosCompressor", "ChaosCommunicator", "ChaosParams",
            "ConsensusConfig", "consensus_step", "fingerprint_tree",
            "force_audit", "audit_report", "normalize_consensus",

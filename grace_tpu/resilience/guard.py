@@ -47,17 +47,43 @@ from grace_tpu.telemetry.aggregate import WatchState
 from grace_tpu.telemetry.state import TelemetryState
 from grace_tpu.transform import set_fallback_flag
 
-__all__ = ["GuardState", "guard_transform"]
+__all__ = ["GuardState", "guard_transform", "GUARD_ROLLBACK_EXCLUDED",
+           "GUARD_SCAN_EXCLUDED_TYPES"]
+
+# The declared rollback-exclusion contract, introspectable instead of
+# living in comments: state leaves whose path contains one of these
+# segments are *deliberately* written through on a bad step rather than
+# restored bitwise by the rollback selects. The first five are the guard's
+# own bookkeeping (GuardState counters — recording the bad step IS their
+# job), and ``fallback`` is the GraceState degradation flag
+# ``set_fallback_flag`` writes AFTER the rollback (routing the next
+# exchange dense is a forward decision, not rolled-back history). Every
+# other state leaf — params, optimizer state, every GraceState mem/comp/
+# telem/watch/count/rng_key/audit/adapt leaf — must be covered by a
+# rollback select, which is exactly what graft-sound's
+# ``rollback_coverage`` pass proves at trace time.
+GUARD_ROLLBACK_EXCLUDED = ("notfinite_count", "last_bad_step",
+                           "consecutive", "fallback_remaining", "step",
+                           "fallback")
+
+# The check_state scan exclusion: the pytree node types holding the
+# GraceState fields named by transform.GRACE_OBSERVATIONAL_FIELDS
+# (telem -> TelemetryState, watch -> WatchState). Kept as types because the
+# strip is structural; tests pin the field<->type correspondence so the
+# two spellings of the one contract cannot drift.
+GUARD_SCAN_EXCLUDED_TYPES = (TelemetryState, WatchState)
 
 
 def _strip_telemetry(tree):
-    """Drop TelemetryState and graft-watch WatchState nodes: both rings are
-    *observational* (they record e.g. the norm — or the cross-rank skew —
-    of a poisoned gradient verbatim), so their contents must never flip a
-    step bad on their own — the pipeline values they mirror are already
-    scanned directly. The rings still roll back with the rest of the inner
-    state on a bad step, so poisoned rows never survive into a flush."""
-    observational = (TelemetryState, WatchState)
+    """Drop TelemetryState and graft-watch WatchState nodes (the
+    ``GRACE_OBSERVATIONAL_FIELDS`` contract — see
+    :data:`GUARD_SCAN_EXCLUDED_TYPES`): both rings are *observational*
+    (they record e.g. the norm — or the cross-rank skew — of a poisoned
+    gradient verbatim), so their contents must never flip a step bad on
+    their own — the pipeline values they mirror are already scanned
+    directly. The rings still roll back with the rest of the inner state
+    on a bad step, so poisoned rows never survive into a flush."""
+    observational = GUARD_SCAN_EXCLUDED_TYPES
     return jax.tree_util.tree_map(
         lambda n: None if isinstance(n, observational) else n,
         tree, is_leaf=lambda n: isinstance(n, observational))

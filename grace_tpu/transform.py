@@ -224,6 +224,16 @@ GRACE_VARYING_FIELDS = ("mem", "comp", "telem", "watch")
 GRACE_REPLICATED_FIELDS = ("count", "rng_key", "fallback", "audit",
                            "adapt")
 
+# The OBSERVATIONAL subset of the varying fields: rings that record
+# pipeline values verbatim (a poisoned gradient's norm, a cross-rank skew
+# column) and therefore must never flip a guarded step bad on their own —
+# the guard's check_state scan strips exactly these
+# (resilience.guard._strip_telemetry ties its type-based strip to this
+# list), while they still ROLL BACK with the rest of the inner state on a
+# bad step. graft-sound's rollback-coverage pass reads this constant
+# instead of re-deriving the contract from comments.
+GRACE_OBSERVATIONAL_FIELDS = ("telem", "watch")
+
 
 def _is_grace(x) -> bool:
     return isinstance(x, GraceState)

@@ -501,7 +501,7 @@ def test_replicated_state_scalars_do_not_fire():
 # ---------------------------------------------------------------------------
 
 def test_graft_lint_all_configs_end_to_end(tmp_path, capsys):
-    """CI gate: the full registry, all seven passes, exit 0 — a pass
+    """CI gate: the full registry, all ten passes, exit 0 — a pass
     regression fails pytest, not just the smoke. Evidence lands at the
     given path with per-pass counts for every pass that ran."""
     graft_lint = _load_tool("graft_lint")
@@ -515,7 +515,8 @@ def test_graft_lint_all_configs_end_to_end(tmp_path, capsys):
     assert set(doc["passes_run"]) == {
         "collective_consistency", "bit_exactness", "wire_reconciliation",
         "signature_stability", "overlap_schedulability", "numeric_safety",
-        "memory_footprint"}
+        "memory_footprint", "rng_lineage", "rollback_coverage",
+        "replication_contract"}
     assert all(v == 0 for v in doc["pass_counts"].values())
     assert doc["configs_audited"] == len(AUDIT_CONFIGS)
     # The static half of the overlap sandwich rides the evidence: every
@@ -589,17 +590,32 @@ def test_evidence_summary_renders_per_pass_counts(tmp_path, monkeypatch):
     assert "numeric_safety 2" in ev.build()
 
 
-def test_chaos_smoke_lint_gate_runs_flow_passes(tmp_path):
-    """chaos_smoke --lint audits its own config with the graft-flow passes
-    before any step runs (clean here; the gate's pass list includes the
-    three new kinds)."""
+def test_chaos_smoke_lint_gate_runs_flow_passes(tmp_path, monkeypatch):
+    """chaos_smoke --lint audits its own config with the graft-flow AND
+    graft-sound passes before any step runs (clean here — the artifact
+    stays free of lint_finding events)."""
+    import grace_tpu.analysis as analysis
     smoke = _load_tool("chaos_smoke")
+    audited = {}
+    real_audit = analysis.audit_config
+
+    def spy(entry, *a, **kw):
+        audited["passes"] = tuple(entry["passes"])
+        return real_audit(entry, *a, **kw)
+
+    # chaos_smoke imports audit_config at gate time, so the module
+    # attribute is the seam.
+    monkeypatch.setattr(analysis, "audit_config", spy)
     out = tmp_path / "smoke.jsonl"
     rc = smoke.main(["--steps", "8", "--nan-prob", "1.0", "--batch", "16",
                      "--fallback-after", "2", "--fallback-steps", "4",
                      "--lint", "--telemetry-out", str(out),
                      "--telemetry-every", "4"])
     assert rc == 0
+    # the smoke's own guarded config must prove its stateful semantics,
+    # not just its collective/flow properties
+    assert {"rng_lineage", "rollback_coverage",
+            "replication_contract"} <= set(audited["passes"])
     # clean gate: no lint_finding events in the artifact
     lines = [json.loads(l) for l in out.read_text().splitlines()]
     assert not [l for l in lines if l.get("event") == "lint_finding"]

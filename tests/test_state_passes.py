@@ -1,0 +1,343 @@
+"""graft-sound: the stateful-semantics passes (ISSUE 20).
+
+Same doctrine as test_analysis.py: the registry stays clean (checked by
+``test_registered_config_audits_clean``, which now runs all ten passes),
+and each new pass is proven LIVE here on a deliberately seeded bad graph —
+reused rng lineage, an un-rolled-back state leaf, a rank-varying write
+into a replicated field. Plus the plain-pytest pin of the field-role /
+partition_specs agreement that pass 10 checks statically.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from grace_tpu.analysis.passes import PASS_NAMES, run_passes
+from grace_tpu.analysis.rules import run_repo_rules
+from grace_tpu.analysis.state_passes import (_contract_drift,
+                                             pass_replication_contract,
+                                             pass_rng_lineage,
+                                             pass_rollback_coverage)
+from grace_tpu.analysis.trace import trace_fn
+from grace_tpu.core import DEFAULT_AXIS
+from grace_tpu.resilience.guard import (GUARD_ROLLBACK_EXCLUDED,
+                                        GUARD_SCAN_EXCLUDED_TYPES)
+from grace_tpu.transform import (GRACE_OBSERVATIONAL_FIELDS,
+                                 GRACE_REPLICATED_FIELDS,
+                                 GRACE_VARYING_FIELDS, GraceState, MeshSpec,
+                                 partition_specs)
+
+pytestmark = pytest.mark.analysis
+
+KEY = jax.ShapeDtypeStruct((2,), jnp.uint32)
+F8 = jax.ShapeDtypeStruct((8,), jnp.float32)
+F4 = jax.ShapeDtypeStruct((4,), jnp.float32)
+I32 = jax.ShapeDtypeStruct((), jnp.int32)
+
+
+def _traced_state(fn, args, paths, varying, name, meta=None):
+    """A TracedGraph with the state-var bookkeeping the graft-sound passes
+    read, built from a bare function: the first ``len(paths)`` args are
+    the state leaves (and the first ``len(paths)`` outputs their step-exit
+    twins), rooted at a bare GraceState (prefix '')."""
+    t = trace_fn(fn, args, varying=varying, name=name, meta=meta)
+    n = len(paths)
+    assert len(t.grad_in) >= n and len(t.body.outvars) >= n
+    t.state_in_vars = list(zip(paths, t.grad_in[:n]))
+    t.state_out_vars = list(zip(paths, t.body.outvars[:n]))
+    t.grace_prefixes = ("",)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# pass 8: rng lineage
+# ---------------------------------------------------------------------------
+
+def test_rng_lineage_fires_on_shared_lineage():
+    """Two independent stochastic sites (different draw shapes) consuming
+    the same derived key — the correlated-noise bug."""
+
+    def bad(kd, w, b):
+        k = jax.random.fold_in(jax.random.wrap_key_data(kd), 7)
+        return (w + jax.random.uniform(k, w.shape),
+                b + jax.random.uniform(k, b.shape))
+
+    t = trace_fn(bad, [KEY, F8, F4], varying=[False, True, True],
+                 name="rng-reuse")
+    findings = pass_rng_lineage(t)
+    assert any("share one rng lineage" in f.message
+               and f.severity == "error" for f in findings), findings
+
+
+def test_rng_lineage_exempts_identical_redraw():
+    """The telemetry-probe idiom: re-drawing the IDENTICAL shape from the
+    same key is one draw after CSE, not two correlated sites."""
+
+    def ok(kd, w):
+        k = jax.random.fold_in(jax.random.wrap_key_data(kd), 3)
+        return w + jax.random.uniform(k, w.shape) \
+            * jax.random.uniform(k, w.shape)
+
+    t = trace_fn(ok, [KEY, F8], varying=[False, True], name="rng-probe")
+    assert pass_rng_lineage(t) == []
+
+
+def test_rng_lineage_blesses_distinct_folds():
+    def ok(kd, w, b):
+        key = jax.random.wrap_key_data(kd)
+        return (w + jax.random.uniform(jax.random.fold_in(key, 0),
+                                       w.shape),
+                b + jax.random.uniform(jax.random.fold_in(key, 1),
+                                       b.shape))
+
+    t = trace_fn(ok, [KEY, F8, F4], varying=[False, True, True],
+                 name="rng-folds")
+    assert pass_rng_lineage(t) == []
+
+
+def test_rng_lineage_exempts_exclusive_branches():
+    """Different arms of one cond are mutually exclusive — the adapt
+    ladder's rungs may derive from one key without correlating."""
+
+    def ok(kd, w, p):
+        k = jax.random.fold_in(jax.random.wrap_key_data(kd), 5)
+        return w[0] + lax.cond(
+            p, lambda: jnp.sum(jax.random.uniform(k, (8,))),
+            lambda: jnp.sum(jax.random.uniform(k, (4,))))
+
+    t = trace_fn(ok, [KEY, F8, jax.ShapeDtypeStruct((), jnp.bool_)],
+                 varying=[False, True, False], name="rng-branches")
+    assert pass_rng_lineage(t) == []
+
+
+def test_rng_lineage_fires_on_rank_varying_key():
+    """A key folded with axis_index draws a different schedule per rank —
+    rank-deterministic selection (cyclictopk, shared Top-K) desyncs."""
+
+    def bad(kd, w):
+        k = jax.random.fold_in(jax.random.wrap_key_data(kd),
+                               lax.axis_index(DEFAULT_AXIS))
+        return w + jax.random.uniform(k, w.shape)
+
+    t = trace_fn(bad, [KEY, F8], varying=[False, True], name="rng-varying")
+    findings = pass_rng_lineage(t)
+    assert any("rank-varying key" in f.message and f.severity == "error"
+               for f in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# pass 9: rollback coverage
+# ---------------------------------------------------------------------------
+
+def _guarded(fn, args, paths, varying, name):
+    return _traced_state(fn, args, paths, varying, name,
+                         meta={"guard": {"fallback_after": 3,
+                                         "fallback_steps": 8}})
+
+
+def test_rollback_coverage_fires_on_unrolled_leaf():
+    """A state leaf written without a guard-gated restore: the new-field-
+    skips-rollback bug, found at trace time instead of in a chaos drill."""
+
+    def bad(count, mem, extra, g):
+        nf = jnp.any(~jnp.isfinite(g))
+        return (jnp.where(nf, count, count + 1),
+                jnp.where(nf, mem, mem + g),
+                extra + 1.0,                      # skips the rollback
+                jnp.sum(g))
+
+    t = _guarded(bad, [I32, F8, F8, F8], ("count", "mem/w", "extra"),
+                 [False, True, True, True], "rollback-miss")
+    findings = pass_rollback_coverage(t)
+    assert len(findings) == 1, findings
+    assert "'extra'" in findings[0].message
+    assert findings[0].severity == "error"
+
+
+def test_rollback_coverage_clean_when_all_leaves_restored():
+    def ok(count, mem, extra, g):
+        nf = jnp.any(~jnp.isfinite(g))
+        return (jnp.where(nf, count, count + 1),
+                jnp.where(nf, mem, mem + g),
+                jnp.where(nf, extra, extra + 1.0),
+                jnp.sum(g))
+
+    t = _guarded(ok, [I32, F8, F8, F8], ("count", "mem/w", "extra"),
+                 [False, True, True, True], "rollback-ok")
+    assert pass_rollback_coverage(t) == []
+
+
+def test_rollback_coverage_honors_declared_exclusions():
+    """Leaves whose path carries a GUARD_ROLLBACK_EXCLUDED segment are
+    deliberately written through — the guard's own counters."""
+
+    def ok(count, step, g):
+        nf = jnp.any(~jnp.isfinite(g))
+        return jnp.where(nf, count, count + 1), step + 1, jnp.sum(g)
+
+    t = _guarded(ok, [I32, I32, F8], ("count", "step"),
+                 [False, False, True], "rollback-excluded")
+    assert pass_rollback_coverage(t) == []
+
+
+def test_rollback_coverage_noops_without_guard():
+    """No guard, no rollback contract: the pass must not condemn plain
+    update-mode traces."""
+
+    def fn(count, g):
+        return count + 1, jnp.sum(g)
+
+    t = _traced_state(fn, [I32, F8], ("count",), [False, True], "no-guard")
+    assert pass_rollback_coverage(t) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 10: replication contract
+# ---------------------------------------------------------------------------
+
+def test_replication_contract_fires_on_rank_varying_write():
+    """axis_index leaking into a replicated field — the adapt-rung desync
+    class pass 10 exists to catch."""
+
+    def bad(count, g):
+        return count + lax.axis_index(DEFAULT_AXIS), jnp.sum(g)
+
+    t = _traced_state(bad, [I32, F8], ("count",), [False, True],
+                      "repl-violation")
+    findings = pass_replication_contract(t)
+    assert any("'count'" in f.message and f.severity == "error"
+               for f in findings), findings
+
+
+def test_replication_contract_blesses_full_axis_reduction():
+    """A write derived from a full-axis psum is replicated by
+    construction — every rank computes the identical reduction."""
+
+    def ok(count, g):
+        return (count + lax.psum(jnp.sum(g), DEFAULT_AXIS).astype(
+            jnp.int32) * 0 + 1, jnp.sum(g))
+
+    t = _traced_state(ok, [I32, F8], ("count",), [False, True],
+                      "repl-psum")
+    assert pass_replication_contract(t) == []
+
+
+def test_replication_contract_warns_on_dead_varying_field():
+    """A GRACE_VARYING_FIELDS field that is provably replicated is
+    sharded dead weight (or belongs in the replicated set)."""
+
+    def lazy(mem, g):
+        return lax.psum(mem, DEFAULT_AXIS) / 8.0, jnp.sum(g)
+
+    t = _traced_state(lazy, [F8, F8], ("mem/w",), [True, True],
+                      "repl-dead-varying")
+    findings = pass_replication_contract(t)
+    assert any(f.severity == "warning" and "'mem'" in f.message
+               for f in findings), findings
+
+
+def test_contract_constants_do_not_drift():
+    """The static third of pass 10, pinned directly."""
+    assert _contract_drift() == ()
+
+
+# ---------------------------------------------------------------------------
+# the field-role / partition_specs agreement (satellite pin)
+# ---------------------------------------------------------------------------
+
+def test_field_roles_exactly_cover_gracestate():
+    varying, replicated = set(GRACE_VARYING_FIELDS), set(
+        GRACE_REPLICATED_FIELDS)
+    assert varying | replicated == set(GraceState._fields)
+    assert not varying & replicated
+    assert set(GRACE_OBSERVATIONAL_FIELDS) <= varying
+
+
+@pytest.mark.parametrize("mesh", [
+    MeshSpec(), MeshSpec(dp_axis="dp", fsdp_axis="fsdp")],
+    ids=["1d", "2d"])
+def test_partition_specs_agree_with_field_roles(mesh):
+    leaf = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    state = GraceState(**{f: leaf for f in GraceState._fields})
+    specs = partition_specs(state, mesh)
+    for f in GraceState._fields:
+        want = mesh.varying_spec() if f in GRACE_VARYING_FIELDS else P()
+        assert getattr(specs, f) == want, f
+
+
+def test_observational_types_match_fields():
+    """The two spellings of the check_state strip contract: field names
+    (transform) and pytree node types (guard) must describe the same set."""
+    from grace_tpu.telemetry.aggregate import WatchState
+    from grace_tpu.telemetry.state import TelemetryState
+
+    assert set(GRACE_OBSERVATIONAL_FIELDS) == {"telem", "watch"}
+    assert set(GUARD_SCAN_EXCLUDED_TYPES) == {TelemetryState, WatchState}
+    assert set(GRACE_OBSERVATIONAL_FIELDS) <= set(GRACE_VARYING_FIELDS)
+
+
+def test_guard_exclusions_name_real_leaves():
+    """Every declared rollback exclusion is a GuardState field or the
+    GraceState fallback flag — a typo here would silently re-arm the
+    rollback-coverage pass on the guard's own counters."""
+    from grace_tpu.resilience.guard import GuardState
+
+    legal = set(GuardState._fields) | {"fallback"}
+    assert set(GUARD_ROLLBACK_EXCLUDED) <= legal
+
+
+# ---------------------------------------------------------------------------
+# registration plumbing + AST rule
+# ---------------------------------------------------------------------------
+
+def test_ten_passes_registered():
+    assert PASS_NAMES[-3:] == ("rng_lineage", "rollback_coverage",
+                               "replication_contract")
+    assert len(PASS_NAMES) == 10
+
+    def fn(x):
+        return x + 1.0
+
+    t = trace_fn(fn, [F8], name="resolve-all")
+    # Every registered name must resolve and run (most no-op on a bare
+    # stateless trace).
+    run_passes(t, PASS_NAMES)
+
+
+def test_field_role_rule_clean_on_repo():
+    assert run_repo_rules(rules=("grace-state-field-roles",)) == []
+
+
+def _transform_src():
+    import os
+
+    from grace_tpu.analysis.rules import repo_root
+
+    with open(os.path.join(repo_root(), "grace_tpu", "transform.py")) as f:
+        return f.read()
+
+
+def test_field_role_rule_fires_on_unroled_field():
+    src = _transform_src()
+    bad = src.replace("    adapt: Any = None",
+                      "    adapt: Any = None\n    shiny_new: Any = None",
+                      1)
+    findings = run_repo_rules(rules=("grace-state-field-roles",),
+                              sources={"grace_tpu/transform.py": bad})
+    assert any(f.details and dict(f.details).get("field") == "shiny_new"
+               and "GRACE_VARYING_FIELDS" in f.message for f in findings)
+
+
+def test_field_role_rule_fires_on_ghost_constant_entry():
+    src = _transform_src()
+    bad = src.replace('GRACE_VARYING_FIELDS = ("mem", "comp", "telem", '
+                      '"watch")',
+                      'GRACE_VARYING_FIELDS = ("mem", "comp", "telem", '
+                      '"watch", "ghost")', 1)
+    assert bad != src
+    findings = run_repo_rules(rules=("grace-state-field-roles",),
+                              sources={"grace_tpu/transform.py": bad})
+    assert any(f.details and dict(f.details).get("field") == "ghost"
+               for f in findings)

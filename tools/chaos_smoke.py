@@ -590,7 +590,7 @@ def main(argv=None) -> int:
                                    step_fn=step)
 
     if args.lint:
-        # Static gate before any step runs: repo rules + the four jaxpr
+        # Static gate before any step runs: repo rules + the jaxpr
         # passes over THIS smoke's production config (pre-chaos-wrapper —
         # the injectors are test fixtures, not an audited deployment).
         # Findings become lint_finding events in the same JSONL artifact
@@ -611,9 +611,15 @@ def main(argv=None) -> int:
              # the wire cost bimodal, same exclusion as the registry's
              # escape entries) — the graft-flow passes (schedulability,
              # numeric safety, footprint) gate this run's config too.
+             # ... plus the graft-sound stateful-semantics passes: the
+             # chaos matrix's whole point is exercising guard rollback
+             # and consensus repair, so the smoke config must itself
+             # prove its rollback write-set and replication contract.
              "passes": ("collective_consistency", "bit_exactness",
                         "signature_stability", "overlap_schedulability",
-                        "numeric_safety", "memory_footprint")})
+                        "numeric_safety", "memory_footprint",
+                        "rng_lineage", "rollback_coverage",
+                        "replication_contract")})
         if sink is not None and lint_findings:
             emit_to_sink(lint_findings, sink)
         errors = [f for f in lint_findings if f.severity == "error"]
